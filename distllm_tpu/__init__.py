@@ -1,0 +1,24 @@
+"""distllm-tpu: TPU-native distributed LLM inference framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``ramanathanlab/distllm`` (see /root/reference): corpus embedding, batch text
+generation with a paged-KV continuous-batching engine, sharded semantic
+similarity search, RAG applications, and MCQA evaluation harnesses.
+
+Layer map (mirrors SURVEY.md section 1, re-architected TPU-first):
+
+- ``distllm_tpu.utils``     — config base (YAML/JSON pydantic models)
+- ``distllm_tpu.registry``  — warmstart cache for compiled models
+- ``distllm_tpu.timer``     — parseable telemetry timers
+- ``distllm_tpu.parallel``  — mesh/sharding helpers + cross-host fabric
+- ``distllm_tpu.models``    — pure-JAX model implementations + HF loaders
+- ``distllm_tpu.ops``       — pallas/XLA kernels (attention, pooling, topk, ...)
+- ``distllm_tpu.embed``     — embedding pipeline (datasets/encoders/poolers/...)
+- ``distllm_tpu.generate``  — generation pipeline + paged-KV engine
+- ``distllm_tpu.rag``       — retrieval index, RAG synthesis, QA eval tasks
+- ``distllm_tpu.mcqa``      — MCQA evaluation harness
+"""
+
+from __future__ import annotations
+
+__version__ = '0.1.0'

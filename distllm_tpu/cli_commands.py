@@ -1,0 +1,21 @@
+"""CLI subcommand registrations.
+
+Grows with the framework; each subcommand defers heavy imports to run time.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from distllm_tpu.cli import subcommand
+
+
+@subcommand('version', 'Print the distllm-tpu version.')
+def _version(parser: argparse.ArgumentParser):
+    def run(args: argparse.Namespace) -> int:
+        import distllm_tpu
+
+        print(distllm_tpu.__version__)
+        return 0
+
+    return run

@@ -1,0 +1,166 @@
+"""Warmstart registry: keep ONE expensive object alive across work items.
+
+Behavioral parity target: ``distllm/registry.py:44-207`` — persistent workers
+process many files via repeated pool ``map`` calls; reloading a model (and on
+TPU, recompiling its jitted functions) per file would dominate runtime. The
+registry caches a single active object keyed by a hash of its constructor
+arguments; a request with different arguments shuts the old object down and
+builds the new one.
+
+TPU-specific addition: the cached object typically owns device-resident params
+*and* compiled executables, so eviction calls an optional ``shutdown()`` hook
+(to drop HBM references) and the cache key incorporates the factory identity,
+so e.g. an encoder and a generator never collide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import json
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, TypeVar
+
+T = TypeVar('T')
+
+
+def _normalize(obj: Any) -> Any:
+    """Reduce kwargs to a deterministic JSON-able structure.
+
+    Address-based ``repr`` fallbacks would make every call a cache miss (a
+    silent warmstart defeat, rebuilding the model per file), so structured
+    objects are decomposed by value first.
+    """
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _normalize(v) for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        items = [_normalize(v) for v in obj]
+        return sorted(items, key=repr) if isinstance(obj, (set, frozenset)) else items
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {'__dc__': type(obj).__qualname__, **_normalize(dataclasses.asdict(obj))}
+    dump = getattr(obj, 'model_dump', None)  # pydantic configs
+    if callable(dump):
+        return {'__model__': type(obj).__qualname__, **_normalize(dump())}
+    return repr(obj)
+
+
+def _stable_hash(obj: Any) -> str:
+    """Deterministic hash of a kwargs structure (by value, not identity)."""
+    payload = json.dumps(_normalize(obj), sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+@dataclass
+class _Entry:
+    key: str
+    value: Any
+
+
+class WarmstartRegistry:
+    """Process-wide cache holding at most one active object per slot.
+
+    ``slots`` exist so that independent object families (encoder vs generator)
+    can each keep one instance warm — a deliberate, small extension of the
+    reference's single-slot design (``registry.py:90-132``) that matches how
+    TPU RAG workers need both a query encoder and a generation engine resident
+    at once.
+    """
+
+    def __init__(self, max_slots: int = 2) -> None:
+        self._lock = threading.RLock()
+        self._slots: dict[str, _Entry] = {}
+        self._max_slots = max_slots
+
+    def get(
+        self,
+        factory: Callable[..., T],
+        slot: str | None = None,
+        **kwargs: Any,
+    ) -> T:
+        """Return the cached object for (factory, kwargs), building if needed.
+
+        A cache miss with a pre-existing entry in the same slot shuts the old
+        object down first (its HBM buffers become collectible before the new
+        model loads — important when two models don't fit together).
+        """
+        slot = slot or getattr(factory, '__qualname__', repr(factory))
+        key = _stable_hash(
+            {'factory': getattr(factory, '__qualname__', repr(factory)), 'kwargs': kwargs}
+        )
+        with self._lock:
+            entry = self._slots.get(slot)
+            if entry is not None and entry.key == key:
+                return entry.value
+            if entry is not None:
+                self._shutdown(entry.value)
+                del self._slots[slot]
+            if len(self._slots) >= self._max_slots:
+                # Evict the oldest slot (insertion order).
+                victim = next(iter(self._slots))
+                self._shutdown(self._slots.pop(victim).value)
+            value = factory(**kwargs)
+            self._slots[slot] = _Entry(key=key, value=value)
+            return value
+
+    def clear(self) -> None:
+        with self._lock:
+            for entry in self._slots.values():
+                self._shutdown(entry.value)
+            self._slots.clear()
+
+    @property
+    def active(self) -> dict[str, Any]:
+        with self._lock:
+            return {slot: e.value for slot, e in self._slots.items()}
+
+    @staticmethod
+    def _shutdown(value: Any) -> None:
+        shutdown = getattr(value, 'shutdown', None)
+        if callable(shutdown):
+            try:
+                shutdown()
+            except Exception:  # noqa: BLE001 - eviction must not fail
+                pass
+
+
+_REGISTRY: WarmstartRegistry | None = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def registry() -> WarmstartRegistry:
+    """Process-wide singleton accessor."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = WarmstartRegistry()
+        return _REGISTRY
+
+
+def register(slot: str | None = None) -> Callable[[Callable[..., T]], Callable[..., T]]:
+    """Decorator: route calls of a factory function through the registry.
+
+    Analogue of the reference's ``@register`` (``registry.py:163-207``): the
+    decorated factory returns a cached instance when called twice with the
+    same kwargs, and swaps the active instance when kwargs change.
+    """
+
+    def deco(factory: Callable[..., T]) -> Callable[..., T]:
+        import inspect
+
+        sig = inspect.signature(factory)
+
+        @functools.wraps(factory)
+        def wrapper(*args: Any, **kwargs: Any) -> T:
+            # Bind positionals to parameter names so make(5) and make(value=5)
+            # hash identically and preserve the factory's calling convention.
+            bound = sig.bind(*args, **kwargs)
+            bound.apply_defaults()
+            return registry().get(factory, slot=slot, **bound.arguments)
+
+        return wrapper
+
+    return deco

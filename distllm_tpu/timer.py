@@ -1,0 +1,119 @@
+"""Nanosecond-precision timers with parseable log lines.
+
+Behavioral parity target: ``distllm/timer.py:36-163`` — a ``Timer`` context
+manager that prints one machine-parseable line per timed span to stdout, and a
+``TimeLogger`` that recovers structured stats from captured logs. Workers time
+every pipeline stage with these, and the lines are the primary telemetry
+channel across the process/node boundary (they survive in scheduler logs).
+
+Line format (one line per completed span)::
+
+    [timer] tags=load-encoder,file-3 elapsed_s=1.234567890 start_ns=... end_ns=...
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+_LINE_RE = re.compile(
+    r'\[timer\] tags=(?P<tags>\S*) '
+    r'elapsed_s=(?P<elapsed>[0-9.eE+-]+) '
+    r'start_ns=(?P<start>\d+) end_ns=(?P<end>\d+)'
+)
+
+
+@dataclass
+class TimeStats:
+    """Aggregated statistics for one tag set."""
+
+    tags: tuple[str, ...]
+    elapsed_s: list[float] = field(default_factory=list)
+    start_ns: list[int] = field(default_factory=list)
+    end_ns: list[int] = field(default_factory=list)
+
+    @property
+    def total_s(self) -> float:
+        return sum(self.elapsed_s)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / len(self.elapsed_s) if self.elapsed_s else 0.0
+
+    @property
+    def count(self) -> int:
+        return len(self.elapsed_s)
+
+
+class Timer:
+    """Context manager that times a span and prints a parseable line.
+
+    >>> with Timer('load-encoder', 'file-3'):
+    ...     do_work()
+    """
+
+    def __init__(self, *tags: str, echo: bool = True) -> None:
+        self.tags = tuple(str(t) for t in tags)
+        self.echo = echo
+        self.start_ns: int | None = None
+        self.end_ns: int | None = None
+
+    @property
+    def elapsed_s(self) -> float:
+        if self.start_ns is None:
+            return 0.0
+        end = self.end_ns if self.end_ns is not None else time.monotonic_ns()
+        return (end - self.start_ns) / 1e9
+
+    def start(self) -> 'Timer':
+        self.start_ns = time.monotonic_ns()
+        self.end_ns = None
+        return self
+
+    def stop(self) -> float:
+        if self.start_ns is None:
+            raise RuntimeError('Timer.stop() called before start()')
+        self.end_ns = time.monotonic_ns()
+        if self.echo:
+            print(self.log_line(), flush=True)
+        return self.elapsed_s
+
+    def log_line(self) -> str:
+        return (
+            f'[timer] tags={",".join(self.tags)} '
+            f'elapsed_s={self.elapsed_s:.9f} '
+            f'start_ns={self.start_ns} end_ns={self.end_ns}'
+        )
+
+    def __enter__(self) -> 'Timer':
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class TimeLogger:
+    """Parse ``[timer]`` lines from captured stdout/log files back to stats.
+
+    Parity with ``TimeLogger.parse_logs`` (``distllm/timer.py:129-154``).
+    """
+
+    def parse_lines(self, lines: list[str] | str) -> dict[tuple[str, ...], TimeStats]:
+        if isinstance(lines, str):
+            lines = lines.splitlines()
+        stats: dict[tuple[str, ...], TimeStats] = {}
+        for line in lines:
+            m = _LINE_RE.search(line)
+            if not m:
+                continue
+            tags = tuple(t for t in m.group('tags').split(',') if t)
+            entry = stats.setdefault(tags, TimeStats(tags=tags))
+            entry.elapsed_s.append(float(m.group('elapsed')))
+            entry.start_ns.append(int(m.group('start')))
+            entry.end_ns.append(int(m.group('end')))
+        return stats
+
+    def parse_logs(self, path: str | Path) -> dict[tuple[str, ...], TimeStats]:
+        return self.parse_lines(Path(path).read_text().splitlines())

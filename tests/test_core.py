@@ -1,0 +1,151 @@
+"""Core layer tests: config IO, batching, timers, warmstart registry."""
+
+from typing import Literal
+
+import pytest
+
+from distllm_tpu import __version__
+from distllm_tpu.registry import WarmstartRegistry, register, registry
+from distllm_tpu.timer import TimeLogger, Timer
+from distllm_tpu.utils import BaseConfig, batch_data, expo_backoff_retry
+
+
+def test_version():
+    assert __version__
+
+
+class _DemoSub(BaseConfig):
+    name: Literal['demo'] = 'demo'
+    width: int = 4
+
+
+class _DemoConfig(BaseConfig):
+    title: str
+    sub: _DemoSub = _DemoSub()
+
+
+def test_config_yaml_roundtrip(tmp_path):
+    cfg = _DemoConfig(title='hello', sub=_DemoSub(width=7))
+    path = tmp_path / 'cfg.yaml'
+    cfg.write_yaml(path)
+    loaded = _DemoConfig.from_yaml(path)
+    assert loaded == cfg
+
+
+def test_config_json_roundtrip(tmp_path):
+    cfg = _DemoConfig(title='x')
+    path = tmp_path / 'cfg.json'
+    cfg.write_json(path)
+    assert _DemoConfig.from_json(path) == cfg
+
+
+def test_config_env_substitution(tmp_path, monkeypatch):
+    monkeypatch.setenv('DISTLLM_TEST_TITLE', 'from-env')
+    path = tmp_path / 'cfg.yaml'
+    path.write_text('title: ${env:DISTLLM_TEST_TITLE}\n')
+    assert _DemoConfig.from_yaml(path).title == 'from-env'
+
+
+def test_config_rejects_unknown_fields():
+    with pytest.raises(Exception):
+        _DemoConfig(title='x', bogus=1)
+
+
+def test_batch_data():
+    assert batch_data([1, 2, 3, 4, 5], 2) == [[1, 2], [3, 4], [5]]
+    assert batch_data([], 3) == []
+    assert batch_data([1], 10) == [[1]]
+    with pytest.raises(ValueError):
+        batch_data([1], 0)
+
+
+def test_timer_roundtrip(capsys):
+    with Timer('stage-a', 'file-1'):
+        pass
+    with Timer('stage-a', 'file-2'):
+        pass
+    with Timer('stage-b'):
+        pass
+    out = capsys.readouterr().out
+    stats = TimeLogger().parse_lines(out)
+    assert stats[('stage-a', 'file-1')].count == 1
+    assert stats[('stage-b',)].count == 1
+    assert stats[('stage-b',)].total_s >= 0
+
+
+def test_timer_logfile(tmp_path, capsys):
+    with Timer('x'):
+        pass
+    log = tmp_path / 'log.txt'
+    log.write_text(capsys.readouterr().out)
+    stats = TimeLogger().parse_logs(log)
+    assert ('x',) in stats
+
+
+class _Expensive:
+    built = 0
+
+    def __init__(self, size):
+        self.size = size
+        _Expensive.built += 1
+        self.dead = False
+
+    def shutdown(self):
+        self.dead = True
+
+
+def test_registry_warmstart():
+    reg = WarmstartRegistry()
+    a = reg.get(_Expensive, size=1)
+    b = reg.get(_Expensive, size=1)
+    assert a is b  # cache hit, no rebuild
+    c = reg.get(_Expensive, size=2)
+    assert c is not a
+    assert a.dead  # old instance shut down on swap
+
+
+def test_registry_slots():
+    reg = WarmstartRegistry(max_slots=2)
+    a = reg.get(_Expensive, slot='encoder', size=1)
+    g = reg.get(_Expensive, slot='generator', size=9)
+    assert reg.get(_Expensive, slot='encoder', size=1) is a
+    assert reg.get(_Expensive, slot='generator', size=9) is g
+
+
+def test_register_decorator():
+    calls = []
+
+    @register(slot='test-deco')
+    def make(value: int):
+        calls.append(value)
+        return {'value': value}
+
+    r1 = make(value=5)
+    r2 = make(value=5)
+    assert r1 is r2
+    assert calls == [5]
+    registry().clear()
+
+
+def test_expo_backoff_retry():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError('boom')
+        return 'ok'
+
+    assert expo_backoff_retry(flaky, sleep=lambda _: None) == 'ok'
+    assert len(attempts) == 3
+
+    class AuthError(Exception):
+        pass
+
+    def fatal():
+        raise AuthError('no')
+
+    with pytest.raises(AuthError):
+        expo_backoff_retry(
+            fatal, give_up_on=(AuthError,), sleep=lambda _: None
+        )
