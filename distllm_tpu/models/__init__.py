@@ -1,0 +1,10 @@
+"""Pure-JAX model implementations and HF-checkpoint loaders.
+
+Models are functional: a pydantic config, an ``init(rng, config) -> params``
+(random init, used in tests and benchmarks), an ``apply(params, batch, ...)``
+pure function, a ``param_specs(config)`` pytree of PartitionSpecs for TP/DP
+sharding, and a ``params_from_hf(state_dict, config)`` converter from
+HuggingFace checkpoints. This replaces the reference's dependence on
+``transformers.AutoModel`` forward passes (``distllm/embed/encoders/auto.py``)
+with compiled, shardable JAX forwards.
+"""

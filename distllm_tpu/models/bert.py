@@ -1,0 +1,207 @@
+"""BERT-family encoder (PubMedBERT / S-PubMedBert-MS-MARCO class models).
+
+TPU-native replacement for the reference's ``AutoEncoder`` forward pass
+(``distllm/embed/encoders/auto.py:119-138``, which returns
+``hidden_states[-1]`` from ``transformers.AutoModel``): a functional JAX
+transformer with stacked-layer ``lax.scan``, bf16 activations, and megatron
+TP sharding specs over the ``model`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distllm_tpu.models import common
+from distllm_tpu.utils import BaseConfig
+
+
+class BertConfig(BaseConfig):
+    name: Literal['bert'] = 'bert'
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_act: str = 'gelu'
+    dtype: str = 'bfloat16'
+
+    @classmethod
+    def from_hf_config(cls, hf: dict) -> 'BertConfig':
+        return cls(
+            vocab_size=hf['vocab_size'],
+            hidden_size=hf['hidden_size'],
+            num_layers=hf['num_hidden_layers'],
+            num_heads=hf['num_attention_heads'],
+            intermediate_size=hf['intermediate_size'],
+            max_position_embeddings=hf.get('max_position_embeddings', 512),
+            type_vocab_size=hf.get('type_vocab_size', 2),
+            layer_norm_eps=hf.get('layer_norm_eps', 1e-12),
+            hidden_act=hf.get('hidden_act', 'gelu'),
+        )
+
+
+def _ln_params(rng, size):
+    return {
+        'scale': np.ones((size,), np.float32),
+        'bias': np.zeros((size,), np.float32),
+    }
+
+
+def init(rng: jax.Array, cfg: BertConfig) -> dict:
+    """Random-init params (tests/benchmarks); layout matches params_from_hf."""
+    rngs = jax.random.split(rng, 8)
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    scale = 0.02
+
+    def normal(key, shape):
+        return np.asarray(jax.random.normal(key, shape) * scale, np.float32)
+
+    layers = []
+    for li in range(cfg.num_layers):
+        key = jax.random.fold_in(rngs[0], li)
+        ks = jax.random.split(key, 6)
+        layers.append(
+            {
+                'q': {'kernel': normal(ks[0], (h, h)), 'bias': np.zeros((h,), np.float32)},
+                'k': {'kernel': normal(ks[1], (h, h)), 'bias': np.zeros((h,), np.float32)},
+                'v': {'kernel': normal(ks[2], (h, h)), 'bias': np.zeros((h,), np.float32)},
+                'o': {'kernel': normal(ks[3], (h, h)), 'bias': np.zeros((h,), np.float32)},
+                'attn_ln': _ln_params(None, h),
+                'up': {'kernel': normal(ks[4], (h, i)), 'bias': np.zeros((i,), np.float32)},
+                'down': {'kernel': normal(ks[5], (i, h)), 'bias': np.zeros((h,), np.float32)},
+                'mlp_ln': _ln_params(None, h),
+            }
+        )
+    return {
+        'embeddings': {
+            'word': normal(rngs[1], (cfg.vocab_size, h)),
+            'position': normal(rngs[2], (cfg.max_position_embeddings, h)),
+            'token_type': normal(rngs[3], (cfg.type_vocab_size, h)),
+            'ln': _ln_params(None, h),
+        },
+        'layers': common.stack_layers(layers),
+    }
+
+
+def apply(
+    params: dict,
+    cfg: BertConfig,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Forward pass: ``[B, S]`` ids/mask → ``[B, S, H]`` last hidden states.
+
+    Numerics follow HF ``BertModel`` (post-LN residual transformer, absolute
+    position embeddings); verified to ~1e-2 in bf16 / 1e-5 in fp32 against
+    ``transformers`` in tests/test_models.py.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    act = common.ACTIVATIONS[cfg.hidden_act]
+    emb = params['embeddings']
+    seq_len = input_ids.shape[1]
+
+    x = (
+        jnp.asarray(emb['word'])[input_ids]
+        + jnp.asarray(emb['position'])[None, :seq_len]
+        + jnp.asarray(emb['token_type'])[0][None, None, :]
+    )
+    x = common.layer_norm(x, emb['ln']['scale'], emb['ln']['bias'], cfg.layer_norm_eps)
+    x = x.astype(dtype)
+    key_mask = attention_mask.astype(bool)
+
+    def layer(x, lp):
+        q = common.split_heads(common.dense(x, lp['q']['kernel'], lp['q']['bias']), cfg.num_heads)
+        k = common.split_heads(common.dense(x, lp['k']['kernel'], lp['k']['bias']), cfg.num_heads)
+        v = common.split_heads(common.dense(x, lp['v']['kernel'], lp['v']['bias']), cfg.num_heads)
+        attn = common.merge_heads(common.sdpa(q, k, v, mask=key_mask))
+        attn = common.dense(attn, lp['o']['kernel'], lp['o']['bias'])
+        # Post-LN residual (BERT): LN(x + sublayer(x)), stats in fp32.
+        x = common.layer_norm(
+            (x + attn).astype(jnp.float32),
+            lp['attn_ln']['scale'],
+            lp['attn_ln']['bias'],
+            cfg.layer_norm_eps,
+        ).astype(dtype)
+        mlp = common.dense(act(common.dense(x, lp['up']['kernel'], lp['up']['bias'])), lp['down']['kernel'], lp['down']['bias'])
+        x = common.layer_norm(
+            (x + mlp).astype(jnp.float32),
+            lp['mlp_ln']['scale'],
+            lp['mlp_ln']['bias'],
+            cfg.layer_norm_eps,
+        ).astype(dtype)
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params['layers'])
+    return x
+
+
+def param_specs(cfg: BertConfig) -> dict:
+    """Megatron-style TP over the ``model`` axis; layer-stack axis unsharded."""
+    col = {'kernel': P(None, None, 'model'), 'bias': P(None, 'model')}
+    row = {'kernel': P(None, 'model', None), 'bias': P(None)}
+    ln = {'scale': P(None), 'bias': P(None)}
+    return {
+        'embeddings': {
+            'word': P(None, None),
+            'position': P(None, None),
+            'token_type': P(None, None),
+            'ln': {'scale': P(), 'bias': P()},
+        },
+        'layers': {
+            'q': dict(col),
+            'k': dict(col),
+            'v': dict(col),
+            'o': dict(row),
+            'attn_ln': dict(ln),
+            'up': dict(col),
+            'down': dict(row),
+            'mlp_ln': dict(ln),
+        },
+    }
+
+
+def params_from_hf(state: dict[str, np.ndarray], cfg: BertConfig) -> dict:
+    """Convert an HF ``BertModel`` state dict to this module's params pytree."""
+    sd = {k.removeprefix('bert.'): v for k, v in state.items()}
+
+    def lin(prefix):  # torch Linear [out, in] -> [in, out]
+        return {
+            'kernel': np.ascontiguousarray(sd[f'{prefix}.weight'].T),
+            'bias': sd[f'{prefix}.bias'],
+        }
+
+    def ln(prefix):
+        return {'scale': sd[f'{prefix}.weight'], 'bias': sd[f'{prefix}.bias']}
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f'encoder.layer.{i}'
+        layers.append(
+            {
+                'q': lin(f'{p}.attention.self.query'),
+                'k': lin(f'{p}.attention.self.key'),
+                'v': lin(f'{p}.attention.self.value'),
+                'o': lin(f'{p}.attention.output.dense'),
+                'attn_ln': ln(f'{p}.attention.output.LayerNorm'),
+                'up': lin(f'{p}.intermediate.dense'),
+                'down': lin(f'{p}.output.dense'),
+                'mlp_ln': ln(f'{p}.output.LayerNorm'),
+            }
+        )
+    return {
+        'embeddings': {
+            'word': sd['embeddings.word_embeddings.weight'],
+            'position': sd['embeddings.position_embeddings.weight'],
+            'token_type': sd['embeddings.token_type_embeddings.weight'],
+            'ln': ln('embeddings.LayerNorm'),
+        },
+        'layers': common.stack_layers(layers),
+    }

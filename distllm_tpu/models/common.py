@@ -1,0 +1,158 @@
+"""Shared functional layers for the pure-JAX models.
+
+Everything is a pure function over explicit parameter pytrees; per-layer
+weights are stacked on a leading axis and traversed with ``lax.scan`` so a
+48-layer model compiles one layer body instead of 48 (compile-time and
+HBM-code-size win on TPU). Attention uses ``jax.nn.dot_product_attention``
+(XLA fuses to flash-attention-style kernels on TPU); custom Pallas kernels
+live in ``distllm_tpu.ops`` and slot in via the ``attn_impl`` argument.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float) -> jnp.ndarray:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    normed = (x - mean) * jax.lax.rsqrt(var + eps)
+    return normed * scale + bias
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+    # Norm statistics in fp32 for bf16 activations (standard TPU practice).
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (normed * scale.astype(jnp.float32)).astype(dtype)
+
+
+def dense(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray | None = None) -> jnp.ndarray:
+    """``x @ kernel (+ bias)`` with kernel laid out ``[in, out]``."""
+    y = jnp.einsum('...i,io->...o', x, kernel.astype(x.dtype))
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+def gelu(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.gelu(x, approximate=False)
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.silu(x)
+
+
+ACTIVATIONS: dict[str, Callable] = {
+    'gelu': gelu,
+    'gelu_new': partial(jax.nn.gelu, approximate=True),
+    'silu': silu,
+    'relu': jax.nn.relu,
+}
+
+
+def split_heads(x: jnp.ndarray, num_heads: int) -> jnp.ndarray:
+    """``[B, S, N*H] -> [B, S, N, H]``."""
+    b, s, d = x.shape
+    return x.reshape(b, s, num_heads, d // num_heads)
+
+
+def merge_heads(x: jnp.ndarray) -> jnp.ndarray:
+    """``[B, S, N, H] -> [B, S, N*H]``."""
+    b, s, n, h = x.shape
+    return x.reshape(b, s, n * h)
+
+
+def sdpa(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mask: jnp.ndarray | None = None,
+    is_causal: bool = False,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Scaled dot-product attention over ``[B, S, N, H]`` tensors.
+
+    ``mask`` is a boolean ``[B, S_kv]`` key-validity mask (attention-mask
+    semantics of the embed pipeline) or a broadcastable full
+    ``[B, N, S_q, S_kv]`` boolean mask.
+    """
+    if mask is not None and mask.ndim == 2:
+        mask = mask[:, None, None, :].astype(bool)
+    return jax.nn.dot_product_attention(
+        q, k, v, mask=mask, is_causal=is_causal, scale=scale
+    )
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float) -> tuple[np.ndarray, np.ndarray]:
+    """Precompute RoPE cos/sin tables ``[max_len, head_dim//2]`` (host-side)."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    t = np.arange(max_len, dtype=np.float64)
+    freqs = np.outer(t, inv_freq)
+    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+    *,
+    interleaved: bool = False,
+) -> jnp.ndarray:
+    """Rotate ``[B, S, N, H]`` queries/keys by position.
+
+    ``interleaved=True`` pairs dims ``(0,1),(2,3),...``; ``False`` pairs
+    ``(i, i+H/2)`` — the HF rotate_half layout used by Llama/Mistral *and*
+    ESM2 (parity tests pin this).
+    """
+    b, s, n, h = x.shape
+    if positions is None:
+        table_cos, table_sin = cos[:s], sin[:s]  # [S, H/2]
+        table_cos = table_cos[None, :, None, :]
+        table_sin = table_sin[None, :, None, :]
+    else:
+        table_cos = cos[positions][:, :, None, :]  # positions [B, S]
+        table_sin = sin[positions][:, :, None, :]
+    table_cos = table_cos.astype(x.dtype)
+    table_sin = table_sin.astype(x.dtype)
+    if interleaved:
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        r1 = x1 * table_cos - x2 * table_sin
+        r2 = x2 * table_cos + x1 * table_sin
+        return jnp.stack([r1, r2], axis=-1).reshape(b, s, n, h)
+    x1 = x[..., : h // 2]
+    x2 = x[..., h // 2 :]
+    r1 = x1 * table_cos - x2 * table_sin
+    r2 = x2 * table_cos + x1 * table_sin
+    return jnp.concatenate([r1, r2], axis=-1)
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """GQA: expand ``[B, S, N_kv, H]`` to ``[B, S, N_kv*n_rep, H]``."""
+    if n_rep == 1:
+        return x
+    b, s, n, h = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, n, n_rep, h)).reshape(
+        b, s, n * n_rep, h
+    )
+
+
+def stack_layers(per_layer: list[dict]) -> dict:
+    """Stack a list of per-layer param dicts into one pytree with leading L."""
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *per_layer)
+
+
+def causal_mask(q_len: int, kv_len: int, offset: int = 0) -> jnp.ndarray:
+    """Boolean ``[q_len, kv_len]`` causal mask; query i sees kv <= i+offset."""
+    q_pos = jnp.arange(q_len)[:, None] + offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return kv_pos <= q_pos

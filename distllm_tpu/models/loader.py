@@ -1,0 +1,70 @@
+"""Checkpoint IO: read HuggingFace-format weights into host numpy arrays.
+
+The reference loads models through ``transformers.AutoModel.from_pretrained``
+(``embed/encoders/auto.py:58-71``); here checkpoints are read directly
+(safetensors preferred, torch ``*.bin`` fallback) and converted to each
+model's params pytree by per-architecture mapping functions that live next to
+the model code (``models/bert.py`` etc.). No network access is performed.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+
+def read_checkpoint(model_dir: str | Path) -> dict[str, np.ndarray]:
+    """Read all weights under ``model_dir`` into a flat {name: ndarray} dict."""
+    model_dir = Path(model_dir)
+    if not model_dir.is_dir():
+        raise FileNotFoundError(
+            f'checkpoint dir not found: {model_dir} '
+            '(network downloads are disabled; pass a local path)'
+        )
+    state: dict[str, np.ndarray] = {}
+    safetensor_files = sorted(model_dir.glob('*.safetensors'))
+    if safetensor_files:
+        from safetensors.numpy import load_file
+
+        for path in safetensor_files:
+            state.update(load_file(str(path)))
+        return state
+    bin_files = sorted(model_dir.glob('*.bin')) + sorted(model_dir.glob('*.pt'))
+    if bin_files:
+        import torch
+
+        for path in bin_files:
+            sd = torch.load(str(path), map_location='cpu', weights_only=True)
+            for k, v in sd.items():
+                state[k] = v.to(torch.float32).numpy() if v.dtype == torch.bfloat16 else v.numpy()
+        return state
+    raise FileNotFoundError(f'no *.safetensors or *.bin under {model_dir}')
+
+
+def read_hf_config(model_dir: str | Path) -> dict:
+    path = Path(model_dir) / 'config.json'
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def save_checkpoint(state: dict[str, np.ndarray], model_dir: str | Path) -> None:
+    """Write a safetensors checkpoint (tests create tiny local models)."""
+    from safetensors.numpy import save_file
+
+    model_dir = Path(model_dir)
+    model_dir.mkdir(parents=True, exist_ok=True)
+    save_file(dict(state), str(model_dir / 'model.safetensors'))
+
+
+def unflatten(flat: dict[str, np.ndarray], sep: str = '.') -> dict:
+    """``{'a.b': x}`` → ``{'a': {'b': x}}`` nested params pytree."""
+    tree: dict = {}
+    for key, value in flat.items():
+        parts = key.split(sep)
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree

@@ -1,0 +1,247 @@
+"""Mistral/Llama-family decoder (SFR-Embedding-Mistral, Mistral-7B-Instruct).
+
+One implementation serves both reference roles:
+
+- the 7B *embedding* model path (``distllm/embed/encoders/auto.py`` with
+  last-token pooling, SURVEY.md section 2.2) via :func:`apply`;
+- the *generation* path (vLLM-backed in the reference,
+  ``generate/generators/vllm_backend.py``) via :func:`prefill` +
+  :func:`decode_step`, which the paged-KV engine drives.
+
+Functional JAX, stacked-layer ``lax.scan``, GQA, RoPE, RMSNorm, SwiGLU; TP
+sharding specs over the ``model`` mesh axis (attention heads and MLP width),
+matching what the reference delegates to vLLM's ``tensor_parallel_size``.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distllm_tpu.models import common
+from distllm_tpu.utils import BaseConfig
+
+
+class MistralConfig(BaseConfig):
+    name: Literal['mistral'] = 'mistral'
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int | None = None
+    intermediate_size: int = 14336
+    max_position_embeddings: int = 32768
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    sliding_window: int | None = None
+    tie_word_embeddings: bool = False
+    dtype: str = 'bfloat16'
+
+    @property
+    def head_size(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf_config(cls, hf: dict) -> 'MistralConfig':
+        return cls(
+            vocab_size=hf['vocab_size'],
+            hidden_size=hf['hidden_size'],
+            num_layers=hf['num_hidden_layers'],
+            num_heads=hf['num_attention_heads'],
+            num_kv_heads=hf.get('num_key_value_heads', hf['num_attention_heads']),
+            head_dim=hf.get('head_dim'),
+            intermediate_size=hf['intermediate_size'],
+            max_position_embeddings=hf.get('max_position_embeddings', 32768),
+            rope_theta=hf.get('rope_theta', 10000.0),
+            rms_norm_eps=hf.get('rms_norm_eps', 1e-5),
+            sliding_window=hf.get('sliding_window'),
+            tie_word_embeddings=hf.get('tie_word_embeddings', False),
+        )
+
+
+def init(rng: jax.Array, cfg: MistralConfig) -> dict:
+    h = cfg.hidden_size
+    hd = cfg.head_size
+    q_out = cfg.num_heads * hd
+    kv_out = cfg.num_kv_heads * hd
+    i = cfg.intermediate_size
+    scale = 0.02
+
+    def normal(key, shape):
+        return np.asarray(jax.random.normal(key, shape) * scale, np.float32)
+
+    keys = jax.random.split(rng, 3)
+    layers = []
+    for li in range(cfg.num_layers):
+        ks = jax.random.split(jax.random.fold_in(keys[0], li), 7)
+        layers.append(
+            {
+                'q': {'kernel': normal(ks[0], (h, q_out))},
+                'k': {'kernel': normal(ks[1], (h, kv_out))},
+                'v': {'kernel': normal(ks[2], (h, kv_out))},
+                'o': {'kernel': normal(ks[3], (q_out, h))},
+                'attn_ln': {'scale': np.ones((h,), np.float32)},
+                'gate': {'kernel': normal(ks[4], (h, i))},
+                'up': {'kernel': normal(ks[5], (h, i))},
+                'down': {'kernel': normal(ks[6], (i, h))},
+                'mlp_ln': {'scale': np.ones((h,), np.float32)},
+            }
+        )
+    params = {
+        'embed': normal(keys[1], (cfg.vocab_size, h)),
+        'layers': common.stack_layers(layers),
+        'final_ln': {'scale': np.ones((h,), np.float32)},
+    }
+    if not cfg.tie_word_embeddings:
+        params['lm_head'] = normal(keys[2], (h, cfg.vocab_size))
+    return params
+
+
+def _rope_tables(cfg: MistralConfig, max_len: int):
+    cos, sin = common.rope_frequencies(cfg.head_size, max_len, cfg.rope_theta)
+    return jnp.asarray(cos), jnp.asarray(sin)
+
+
+def _attn_mask(attention_mask: jnp.ndarray, cfg: MistralConfig) -> jnp.ndarray:
+    """Causal x key-validity boolean mask ``[B, 1, S, S]`` (+ sliding window)."""
+    seq = attention_mask.shape[1]
+    causal = common.causal_mask(seq, seq)
+    if cfg.sliding_window is not None:
+        q_pos = jnp.arange(seq)[:, None]
+        kv_pos = jnp.arange(seq)[None, :]
+        causal = causal & (kv_pos > q_pos - cfg.sliding_window)
+    return causal[None, None] & attention_mask[:, None, None, :].astype(bool)
+
+
+def apply(
+    params: dict,
+    cfg: MistralConfig,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Dense causal forward: ``[B, S]`` → last hidden states ``[B, S, H]``."""
+    hidden, _, _ = _forward(params, cfg, input_ids, attention_mask, collect_kv=False)
+    return hidden
+
+
+def prefill(
+    params: dict,
+    cfg: MistralConfig,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Forward that also returns per-layer K/V ``[L, B, S, N_kv, Hd]``."""
+    return _forward(params, cfg, input_ids, attention_mask, collect_kv=True)
+
+
+def _forward(params, cfg, input_ids, attention_mask, *, collect_kv):
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = input_ids.shape
+    cos, sin = _rope_tables(cfg, s)
+    x = jnp.asarray(params['embed'])[input_ids].astype(dtype)
+    mask = _attn_mask(attention_mask, cfg)
+    positions = None  # prefill positions are 0..S-1 per row
+
+    def layer(x, lp):
+        normed = common.rms_norm(x, lp['attn_ln']['scale'], cfg.rms_norm_eps)
+        q = common.split_heads(common.dense(normed, lp['q']['kernel']), cfg.num_heads)
+        k = common.split_heads(common.dense(normed, lp['k']['kernel']), cfg.num_kv_heads)
+        v = common.split_heads(common.dense(normed, lp['v']['kernel']), cfg.num_kv_heads)
+        q = common.apply_rope(q, cos, sin, positions)
+        k = common.apply_rope(k, cos, sin, positions)
+        # GQA handled natively by the fused attention (no KV materialization).
+        attn = common.sdpa(q, k, v, mask=mask)
+        x = x + common.dense(common.merge_heads(attn), lp['o']['kernel'])
+        normed2 = common.rms_norm(x, lp['mlp_ln']['scale'], cfg.rms_norm_eps)
+        mlp = common.dense(
+            common.silu(common.dense(normed2, lp['gate']['kernel']))
+            * common.dense(normed2, lp['up']['kernel']),
+            lp['down']['kernel'],
+        )
+        x = x + mlp
+        return x, (k, v) if collect_kv else None
+
+    x, kv = jax.lax.scan(layer, x, params['layers'])
+    hidden = common.rms_norm(x, params['final_ln']['scale'], cfg.rms_norm_eps)
+    if collect_kv:
+        return hidden, kv[0], kv[1]
+    return hidden, None, None
+
+
+def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray:
+    """LM head: ``[..., H]`` hidden → fp32 ``[..., V]`` logits."""
+    if cfg.tie_word_embeddings or 'lm_head' not in params:
+        kernel = jnp.asarray(params['embed']).T
+    else:
+        kernel = jnp.asarray(params['lm_head'])
+    return common.dense(hidden, kernel).astype(jnp.float32)
+
+
+def param_specs(cfg: MistralConfig, params: dict | None = None) -> dict:
+    """Sharding specs structurally matching ``params``.
+
+    Encoder-only checkpoints (SFR-Embedding-Mistral) have no ``lm_head`` even
+    with untied embeddings, so the spec tree mirrors the actual params when
+    they are provided.
+    """
+    col = {'kernel': P(None, None, 'model')}
+    row = {'kernel': P(None, 'model', None)}
+    specs = {
+        'embed': P(None, None),
+        'layers': {
+            'q': dict(col),
+            'k': dict(col),
+            'v': dict(col),
+            'o': dict(row),
+            'attn_ln': {'scale': P(None)},
+            'gate': dict(col),
+            'up': dict(col),
+            'down': dict(row),
+            'mlp_ln': {'scale': P(None)},
+        },
+        'final_ln': {'scale': P()},
+    }
+    has_lm_head = (
+        'lm_head' in params if params is not None else not cfg.tie_word_embeddings
+    )
+    if has_lm_head:
+        specs['lm_head'] = P(None, 'model')
+    return specs
+
+
+def params_from_hf(state: dict[str, np.ndarray], cfg: MistralConfig) -> dict:
+    """Convert HF ``MistralForCausalLM``/``MistralModel`` weights."""
+    sd = {k.removeprefix('model.'): v for k, v in state.items()}
+
+    def lin(key):
+        return {'kernel': np.ascontiguousarray(sd[key].T)}
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f'layers.{i}'
+        layers.append(
+            {
+                'q': lin(f'{p}.self_attn.q_proj.weight'),
+                'k': lin(f'{p}.self_attn.k_proj.weight'),
+                'v': lin(f'{p}.self_attn.v_proj.weight'),
+                'o': lin(f'{p}.self_attn.o_proj.weight'),
+                'attn_ln': {'scale': sd[f'{p}.input_layernorm.weight']},
+                'gate': lin(f'{p}.mlp.gate_proj.weight'),
+                'up': lin(f'{p}.mlp.up_proj.weight'),
+                'down': lin(f'{p}.mlp.down_proj.weight'),
+                'mlp_ln': {'scale': sd[f'{p}.post_attention_layernorm.weight']},
+            }
+        )
+    params = {
+        'embed': sd['embed_tokens.weight'],
+        'layers': common.stack_layers(layers),
+        'final_ln': {'scale': sd['norm.weight']},
+    }
+    if 'lm_head.weight' in state and not cfg.tie_word_embeddings:
+        params['lm_head'] = np.ascontiguousarray(state['lm_head.weight'].T)
+    return params

@@ -1,0 +1,33 @@
+"""Parallelism: device meshes, sharding rules, collectives, cross-host fabric.
+
+This package is the TPU replacement for the reference's scale-out substrate
+(Parsl HTEX + NCCL-inside-vLLM; SURVEY.md section 2.5): intra-slice parallelism
+is expressed as ``jax.sharding`` over an explicit ``Mesh`` (XLA emits ICI
+collectives), and cross-host fan-out is a file-sharded pool executor.
+"""
+
+from distllm_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    MeshSpec,
+    make_mesh,
+)
+from distllm_tpu.parallel.sharding import (
+    named_sharding,
+    replicate,
+    shard_pytree,
+)
+
+__all__ = [
+    'DATA_AXIS',
+    'MODEL_AXIS',
+    'SEQ_AXIS',
+    'EXPERT_AXIS',
+    'MeshSpec',
+    'make_mesh',
+    'named_sharding',
+    'replicate',
+    'shard_pytree',
+]

@@ -1,0 +1,109 @@
+"""Device mesh construction.
+
+The framework uses one explicit mesh with up to four named axes:
+
+- ``data``   — batch-dim sharding (DP); maps to the reference's file-level
+  data parallelism *within* a host (SURVEY.md section 2.5, row DP).
+- ``model``  — tensor parallelism over attention heads / MLP widths (the
+  reference passes ``tensor_parallel_size`` through to vLLM; here it is a
+  first-class mesh axis laid out over ICI).
+- ``seq``    — sequence/context parallelism (ring attention) for long inputs;
+  absent in the reference (it truncates instead) but first-class here.
+- ``expert`` — expert parallelism for MoE checkpoints (reserved).
+
+Axis sizes are chosen so ``data`` is outermost (DCN-friendly) and ``model`` is
+innermost (ICI-friendly), following the standard TPU scaling recipe.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Literal
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from distllm_tpu.utils import BaseConfig
+
+DATA_AXIS = 'data'
+MODEL_AXIS = 'model'
+SEQ_AXIS = 'seq'
+EXPERT_AXIS = 'expert'
+
+AXIS_ORDER = (DATA_AXIS, SEQ_AXIS, EXPERT_AXIS, MODEL_AXIS)
+
+
+class MeshSpec(BaseConfig):
+    """Declarative mesh shape; ``-1`` on one axis means "fill remaining".
+
+    Example: on 8 chips, ``MeshSpec(data=-1, model=2)`` builds a 4x2
+    ``(data, model)`` mesh.
+    """
+
+    name: Literal['mesh'] = 'mesh'
+    data: int = -1
+    seq: int = 1
+    expert: int = 1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {
+            DATA_AXIS: self.data,
+            SEQ_AXIS: self.seq,
+            EXPERT_AXIS: self.expert,
+            MODEL_AXIS: self.model,
+        }
+        fills = [ax for ax, s in sizes.items() if s == -1]
+        if len(fills) > 1:
+            raise ValueError(f'at most one mesh axis may be -1, got {fills}')
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if fills:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f'{n_devices} devices not divisible by fixed axes {fixed}'
+                )
+            sizes[fills[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f'mesh {sizes} needs {fixed} devices, have {n_devices}'
+            )
+        return sizes
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    devices: list | None = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` from a spec or keyword axis sizes.
+
+    Keeps every declared axis in the mesh (size-1 axes are free), so model
+    code can always annotate with all four logical axes regardless of the
+    physical configuration.
+    """
+    if spec is None:
+        spec = MeshSpec(**axis_sizes)
+    if devices is None:
+        devices = jax.devices()
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[ax] for ax in AXIS_ORDER)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        # CPU/virtual device fallback: plain reshape (no ICI topology to
+        # optimize for anyway).
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def single_device_mesh() -> Mesh:
+    """1-chip mesh (all axes size 1) — used by single-host CLI paths."""
+    return make_mesh(MeshSpec(data=1, seq=1, expert=1, model=1), devices=jax.devices()[:1])

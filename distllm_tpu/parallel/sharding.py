@@ -1,0 +1,52 @@
+"""Sharding helpers: NamedSharding construction and rule-based pytree sharding.
+
+Models in this framework expose a ``param_specs(config) -> pytree[PartitionSpec]``
+alongside ``init``/``apply``; these helpers place a host-side params pytree
+onto the mesh accordingly. XLA then inserts the ICI collectives (all-reduce
+for TP matmuls, all-gather at layout boundaries) — nothing here issues
+explicit communication.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_pytree(params: Any, specs: Any, mesh: Mesh) -> Any:
+    """Device-put ``params`` with per-leaf PartitionSpecs from ``specs``.
+
+    ``specs`` must be a pytree prefix-compatible with ``params`` whose leaves
+    are ``PartitionSpec``s. Axes named in a spec that have size 1 in the mesh
+    are legal (no-op sharding), so the same specs work from 1 chip to a pod.
+    """
+    flat_p, tree = jax.tree_util.tree_flatten(params)
+    flat_s = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P) or x is None
+    )
+    if len(flat_p) != len(flat_s):
+        raise ValueError(
+            f'params/specs mismatch: {len(flat_p)} arrays vs {len(flat_s)} specs'
+        )
+    placed = [
+        jax.device_put(p, NamedSharding(mesh, s if s is not None else P()))
+        for p, s in zip(flat_p, flat_s)
+    ]
+    return jax.tree_util.tree_unflatten(tree, placed)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Standard activation sharding: batch over data axis, rest replicated."""
+    from distllm_tpu.parallel.mesh import DATA_AXIS
+
+    return NamedSharding(mesh, P(DATA_AXIS))

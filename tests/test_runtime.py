@@ -1,0 +1,103 @@
+"""Runtime tests: mesh construction, sharding, tokenizer bucketing, loader."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distllm_tpu.models.loader import (
+    read_checkpoint,
+    save_checkpoint,
+    unflatten,
+)
+from distllm_tpu.models.tokenizer import (
+    TokenBatch,
+    WhitespaceTokenizer,
+    bucket_ladder,
+    pick_bucket,
+)
+from distllm_tpu.parallel import make_mesh, named_sharding, shard_pytree
+from distllm_tpu.parallel.mesh import MeshSpec
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec(data=-1, model=2).resolve(8) == {
+        'data': 4,
+        'seq': 1,
+        'expert': 1,
+        'model': 2,
+    }
+    with pytest.raises(ValueError):
+        MeshSpec(data=3, model=2).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=-1).resolve(8)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(MeshSpec(data=2, seq=2, model=2))
+    assert mesh.shape == {'data': 2, 'seq': 2, 'expert': 1, 'model': 2}
+
+
+def test_shard_pytree_matmul():
+    mesh = make_mesh(MeshSpec(data=1, model=8))
+    params = {'w': np.arange(32 * 16, dtype=np.float32).reshape(32, 16)}
+    specs = {'w': P(None, 'model')}
+    sharded = shard_pytree(params, specs, mesh)
+    x = np.ones((4, 32), np.float32)
+    out = jax.jit(lambda p, x: x @ p['w'])(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), x @ params['w'])
+
+
+def test_bucket_ladder():
+    assert bucket_ladder(512, 16) == [16, 32, 64, 128, 256, 512]
+    assert bucket_ladder(100, 16) == [16, 32, 64, 100]
+    assert pick_bucket(33, [16, 32, 64]) == 64
+    assert pick_bucket(999, [16, 32, 64]) == 64
+
+
+def test_whitespace_tokenizer_buckets():
+    tok = WhitespaceTokenizer(vocab_size=1000, model_max_length=64)
+    batch = tok(['hello world', 'a b c d e f g'])
+    assert batch.shape == (2, 16)  # smallest bucket
+    assert batch.attention_mask[0].sum() == 4  # cls + 2 tokens + sep
+    # Determinism across instances:
+    tok2 = WhitespaceTokenizer(vocab_size=1000, model_max_length=64)
+    batch2 = tok2(['hello world', 'a b c d e f g'])
+    np.testing.assert_array_equal(batch.input_ids, batch2.input_ids)
+
+
+def test_whitespace_tokenizer_truncation():
+    tok = WhitespaceTokenizer(vocab_size=1000, model_max_length=8)
+    batch = tok(['one two three four five six seven eight nine ten'])
+    assert batch.shape == (1, 8)
+    assert batch.input_ids[0, 0] == tok.cls_id
+    assert batch.input_ids[0, 7] == tok.sep_id
+
+
+def test_token_batch_pad_batch():
+    tb = TokenBatch(
+        np.ones((2, 8), np.int32), np.ones((2, 8), np.int32)
+    ).pad_batch_to(4)
+    assert tb.shape == (4, 8)
+    assert tb.attention_mask[2:].sum() == 0
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = {
+        'layer.weight': np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    }
+    save_checkpoint(state, tmp_path / 'ckpt')
+    loaded = read_checkpoint(tmp_path / 'ckpt')
+    np.testing.assert_array_equal(loaded['layer.weight'], state['layer.weight'])
+
+
+def test_checkpoint_missing_dir():
+    with pytest.raises(FileNotFoundError):
+        read_checkpoint('/nonexistent/model/dir')
+
+
+def test_unflatten():
+    tree = unflatten({'a.b.c': 1, 'a.d': 2})
+    assert tree == {'a': {'b': {'c': 1}, 'd': 2}}
