@@ -1,0 +1,101 @@
+"""Headline benchmark: embeddings/sec/chip on a PubMedBERT-class encoder.
+
+Runs the embed pipeline hot loop (bucketed tokenize → jitted bf16 BERT
+forward → mean pool → host copy) on whatever single chip jax provides, and
+prints ONE JSON line::
+
+    {"metric": "embeddings/sec/chip", "value": N, "unit": "emb/s",
+     "vs_baseline": R}
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is reported
+against an analytic A100 estimate for the same model/batch derived from the
+reference's production config (PubMedBERT batch 512, ``README.md:65``):
+A100 bf16 peak 312 TFLOP/s at 50% MFU on ~2*P*T FLOPs/token. This keeps the
+ratio honest and reproducible rather than inherited from nowhere.
+
+Zero egress: weights are random-init at exact PubMedBERT dims (numerics are
+irrelevant to throughput) and the tokenizer is the deterministic hash-vocab
+one at BERT vocab size.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def _synthetic_corpus(n_docs: int, rng: np.random.Generator) -> list[str]:
+    """Chunk-sized texts (~150-250 'words') like jsonl_chunk buffers."""
+    vocab = [f'tok{i}' for i in range(5000)]
+    texts = []
+    for _ in range(n_docs):
+        n = int(rng.integers(120, 260))
+        texts.append(' '.join(rng.choice(vocab, size=n)))
+    return texts
+
+
+def main() -> None:
+    from distllm_tpu.embed import get_encoder, get_pooler
+    from distllm_tpu.embed.embedders.full_sequence import compute_embeddings
+    from distllm_tpu.embed.encoders.base import JaxEncoder
+    from distllm_tpu.models import bert
+    from distllm_tpu.models.tokenizer import WhitespaceTokenizer
+
+    rng = np.random.default_rng(0)
+
+    # PubMedBERT dims (microsoft/S-PubMedBert-MS-MARCO): BERT-base.
+    cfg = bert.BertConfig(
+        vocab_size=30522,
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        intermediate_size=3072,
+        max_position_embeddings=512,
+        dtype='bfloat16',
+    )
+    params = bert.init(jax.random.PRNGKey(0), cfg)
+    tokenizer = WhitespaceTokenizer(vocab_size=cfg.vocab_size, model_max_length=512)
+    encoder = JaxEncoder(
+        config=None,
+        apply_fn=bert.apply,
+        model_cfg=cfg,
+        params=jax.device_put(params),
+        tokenizer=tokenizer,
+        embedding_size=cfg.hidden_size,
+    )
+    pooler = get_pooler({'name': 'mean'})
+
+    batch_size = 128
+    texts = _synthetic_corpus(1024, rng)
+
+    # Warmup (compile per bucket) then timed steady-state pass.
+    compute_embeddings(texts[: batch_size * 2], encoder, pooler, batch_size)
+    jax.block_until_ready(encoder.params)
+    start = time.perf_counter()
+    out = compute_embeddings(texts, encoder, pooler, batch_size)
+    elapsed = time.perf_counter() - start
+    throughput = len(texts) / elapsed
+
+    # Analytic A100 estimate for the same workload (see module docstring):
+    # ~2 * 110e6 params * 256 tokens/seq FLOPs, 312 TF/s * 50% MFU.
+    flops_per_seq = 2 * 110e6 * 256
+    a100_estimate = (312e12 * 0.50) / flops_per_seq
+
+    print(
+        json.dumps(
+            {
+                'metric': 'embeddings/sec/chip',
+                'value': round(throughput, 2),
+                'unit': 'emb/s',
+                'vs_baseline': round(throughput / a100_estimate, 3),
+            }
+        )
+    )
+    assert out.shape == (len(texts), cfg.hidden_size)
+
+
+if __name__ == '__main__':
+    main()

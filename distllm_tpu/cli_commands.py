@@ -19,3 +19,108 @@ def _version(parser: argparse.ArgumentParser):
         return 0
 
     return run
+
+
+@subcommand('embed', 'Embed input files on this host (single-process loop).')
+def _embed(parser: argparse.ArgumentParser):
+    """Reference parity: ``distllm/cli.py:14-192`` (single-GPU embed loop)."""
+    parser.add_argument('--input_dir', required=True)
+    parser.add_argument('--output_dir', required=True)
+    parser.add_argument('--glob_patterns', nargs='+', default=['*'])
+    parser.add_argument('--encoder_name', default='auto')
+    parser.add_argument('--pretrained_model_name_or_path', default=None)
+    parser.add_argument('--dataset_name', default='jsonl_chunk')
+    parser.add_argument('--batch_size', type=int, default=8)
+    parser.add_argument('--pooler_name', default='mean')
+    parser.add_argument('--embedder_name', default='full_sequence')
+    parser.add_argument('--writer_name', default='huggingface')
+    parser.add_argument('--normalize_embeddings', action='store_true')
+
+    def run(args: argparse.Namespace) -> int:
+        from distllm_tpu.distributed_embedding import Config, run_embedding
+
+        encoder_kwargs = {'name': args.encoder_name}
+        if args.pretrained_model_name_or_path:
+            encoder_kwargs['pretrained_model_name_or_path'] = (
+                args.pretrained_model_name_or_path
+            )
+        config = Config(
+            input_dir=args.input_dir,
+            output_dir=args.output_dir,
+            glob_patterns=args.glob_patterns,
+            dataset_config={
+                'name': args.dataset_name,
+                'batch_size': args.batch_size,
+            },
+            encoder_config=encoder_kwargs,
+            pooler_config={'name': args.pooler_name},
+            embedder_config={
+                'name': args.embedder_name,
+                'normalize_embeddings': args.normalize_embeddings,
+            },
+            writer_config={'name': args.writer_name},
+        )
+        return run_embedding(config)
+
+    return run
+
+
+@subcommand('merge', 'Merge embedding shards into one dataset.')
+def _merge(parser: argparse.ArgumentParser):
+    """Reference parity: ``distllm/cli.py:195-245`` (the map-reduce reduce)."""
+    parser.add_argument('--dataset_dir', required=True, help='Dir of shards.')
+    parser.add_argument('--output_dir', required=True)
+    parser.add_argument('--writer_name', default='huggingface')
+    parser.add_argument('--num_proc', type=int, default=None)
+
+    def run(args: argparse.Namespace) -> int:
+        from pathlib import Path
+
+        from distllm_tpu.embed import get_writer
+
+        writer_kwargs = {'name': args.writer_name}
+        if args.writer_name == 'huggingface' and args.num_proc:
+            writer_kwargs['num_proc'] = args.num_proc
+        writer = get_writer(writer_kwargs)
+        shards = sorted(
+            p for p in Path(args.dataset_dir).iterdir() if p.is_dir()
+        )
+        if not shards:
+            print(f'No shard dirs in {args.dataset_dir}')
+            return 1
+        writer.merge(shards, args.output_dir)
+        print(f'Merged {len(shards)} shards -> {args.output_dir}')
+        return 0
+
+    return run
+
+
+@subcommand('chunk_fasta_file', 'Split a FASTA file into N shard files.')
+def _chunk_fasta(parser: argparse.ArgumentParser):
+    """Reference parity: ``distllm/cli.py:476-514``."""
+    parser.add_argument('--fasta_file', required=True)
+    parser.add_argument('--output_dir', required=True)
+    parser.add_argument('--num_chunks', type=int, required=True)
+
+    def run(args: argparse.Namespace) -> int:
+        from pathlib import Path
+
+        from distllm_tpu.embed.datasets.fasta import read_fasta, write_fasta
+
+        sequences = read_fasta(args.fasta_file)
+        if not sequences:
+            print(f'No sequences found in {args.fasta_file}')
+            return 1
+        out = Path(args.output_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        n = max(1, args.num_chunks)
+        per = (len(sequences) + n - 1) // n
+        stem = Path(args.fasta_file).stem
+        for i in range(0, len(sequences), per):
+            write_fasta(
+                sequences[i : i + per], out / f'{stem}.chunk{i // per:04d}.fasta'
+            )
+        print(f'Wrote {(len(sequences) + per - 1) // per} chunks to {out}')
+        return 0
+
+    return run

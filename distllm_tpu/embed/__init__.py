@@ -1,0 +1,26 @@
+"""Embedding pipeline: datasets → encoders → poolers → embedders → writers.
+
+Mirrors the reference's five strategy families (``distllm/embed/__init__.py``)
+with the same YAML-discriminated-union configuration scheme, re-designed for
+TPU: fixed-shape bucketed batching, jit-cached encoder forwards, and jitted
+pooling kernels.
+"""
+
+from distllm_tpu.embed.datasets import DatasetConfigs, get_dataset
+from distllm_tpu.embed.embedders import EmbedderConfigs, get_embedder
+from distllm_tpu.embed.encoders import EncoderConfigs, get_encoder
+from distllm_tpu.embed.poolers import PoolerConfigs, get_pooler
+from distllm_tpu.embed.writers import WriterConfigs, get_writer
+
+__all__ = [
+    'DatasetConfigs',
+    'EmbedderConfigs',
+    'EncoderConfigs',
+    'PoolerConfigs',
+    'WriterConfigs',
+    'get_dataset',
+    'get_embedder',
+    'get_encoder',
+    'get_pooler',
+    'get_writer',
+]

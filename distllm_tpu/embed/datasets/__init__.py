@@ -1,0 +1,52 @@
+"""Dataset strategy factory (reference: ``distllm/embed/datasets/__init__.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.embed.datasets.base import Dataset, TextCorpus
+from distllm_tpu.embed.datasets.fasta import FastaDataset, FastaDatasetConfig
+from distllm_tpu.embed.datasets.huggingface import (
+    HuggingFaceDataset,
+    HuggingFaceDatasetConfig,
+)
+from distllm_tpu.embed.datasets.jsonl import JsonlDataset, JsonlDatasetConfig
+from distllm_tpu.embed.datasets.jsonl_chunk import (
+    JsonlChunkDataset,
+    JsonlChunkDatasetConfig,
+)
+from distllm_tpu.embed.datasets.single_line import (
+    SequencePerLineDataset,
+    SequencePerLineDatasetConfig,
+)
+
+DatasetConfigs = Union[
+    JsonlDatasetConfig,
+    JsonlChunkDatasetConfig,
+    FastaDatasetConfig,
+    SequencePerLineDatasetConfig,
+    HuggingFaceDatasetConfig,
+]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'jsonl': (JsonlDatasetConfig, JsonlDataset),
+    'jsonl_chunk': (JsonlChunkDatasetConfig, JsonlChunkDataset),
+    'fasta': (FastaDatasetConfig, FastaDataset),
+    'sequence_per_line': (SequencePerLineDatasetConfig, SequencePerLineDataset),
+    'huggingface': (HuggingFaceDatasetConfig, HuggingFaceDataset),
+}
+
+
+def get_dataset(kwargs: dict[str, Any]) -> Dataset:
+    """Build a dataset strategy from ``{'name': ..., **config}`` kwargs."""
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown dataset name: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+__all__ = ['Dataset', 'TextCorpus', 'DatasetConfigs', 'get_dataset', 'STRATEGIES']

@@ -1,0 +1,39 @@
+"""Dataset protocol: read one input file into an in-memory text corpus.
+
+Reference parity: ``distllm/embed/datasets/base.py:14-40`` returns a torch
+``DataLoader``; here a dataset returns a :class:`TextCorpus` (texts + aligned
+metadata) and batching/tokenization happen downstream in the embedder with
+bucketed fixed shapes (TPU recompile discipline).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Protocol, runtime_checkable
+
+
+@dataclass
+class TextCorpus:
+    """Texts plus optional aligned per-text metadata."""
+
+    texts: list[str]
+    metadata: list[dict] | None = None
+
+    def __post_init__(self) -> None:
+        if self.metadata is not None and len(self.metadata) != len(self.texts):
+            raise ValueError(
+                f'metadata length {len(self.metadata)} != texts {len(self.texts)}'
+            )
+
+    def __len__(self) -> int:
+        return len(self.texts)
+
+
+@runtime_checkable
+class Dataset(Protocol):
+    """Strategy protocol for reading an input file."""
+
+    config: object
+
+    def read(self, data_file: str | Path) -> TextCorpus: ...

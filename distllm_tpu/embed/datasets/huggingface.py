@@ -1,0 +1,38 @@
+"""HuggingFace on-disk dataset with selectable metadata columns.
+
+Reference parity: ``distllm/embed/datasets/huggingface.py:35-83``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Literal
+
+from distllm_tpu.embed.datasets.base import TextCorpus
+from distllm_tpu.utils import BaseConfig
+
+
+class HuggingFaceDatasetConfig(BaseConfig):
+    name: Literal['huggingface'] = 'huggingface'
+    text_field: str = 'text'
+    metadata_fields: list[str] = []
+    batch_size: int = 8
+
+
+class HuggingFaceDataset:
+    def __init__(self, config: HuggingFaceDatasetConfig) -> None:
+        self.config = config
+
+    def read(self, data_file: str | Path) -> TextCorpus:
+        from datasets import load_from_disk
+
+        ds = load_from_disk(str(data_file))
+        texts = list(ds[self.config.text_field])
+        metadata = None
+        if self.config.metadata_fields:
+            columns = {f: ds[f] for f in self.config.metadata_fields}
+            metadata = [
+                {f: columns[f][i] for f in self.config.metadata_fields}
+                for i in range(len(texts))
+            ]
+        return TextCorpus(texts=texts, metadata=metadata)

@@ -1,0 +1,39 @@
+"""Jsonl dataset: one JSON object per line, extract a text field.
+
+Reference parity: ``distllm/embed/datasets/jsonl.py:33-73``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Literal
+
+from distllm_tpu.embed.datasets.base import TextCorpus
+from distllm_tpu.utils import BaseConfig
+
+
+class JsonlDatasetConfig(BaseConfig):
+    name: Literal['jsonl'] = 'jsonl'
+    text_field: str = 'text'
+    batch_size: int = 8
+
+
+class JsonlDataset:
+    def __init__(self, config: JsonlDatasetConfig) -> None:
+        self.config = config
+
+    def read(self, data_file: str | Path) -> TextCorpus:
+        texts: list[str] = []
+        metadata: list[dict] = []
+        with open(data_file) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                entry = json.loads(line)
+                texts.append(entry[self.config.text_field])
+                metadata.append(
+                    {k: v for k, v in entry.items() if k != self.config.text_field}
+                )
+        return TextCorpus(texts, metadata)

@@ -1,0 +1,32 @@
+"""Sequence-per-line dataset: plain text, one item per line, skip N headers.
+
+Reference parity: ``distllm/embed/datasets/single_line.py:32-68``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Literal
+
+from distllm_tpu.embed.datasets.base import TextCorpus
+from distllm_tpu.utils import BaseConfig
+
+
+class SequencePerLineDatasetConfig(BaseConfig):
+    name: Literal['sequence_per_line'] = 'sequence_per_line'
+    header_lines: int = 0
+    batch_size: int = 8
+
+
+class SequencePerLineDataset:
+    def __init__(self, config: SequencePerLineDatasetConfig) -> None:
+        self.config = config
+
+    def read(self, data_file: str | Path) -> TextCorpus:
+        lines = Path(data_file).read_text().splitlines()
+        texts = [
+            line.strip()
+            for line in lines[self.config.header_lines :]
+            if line.strip()
+        ]
+        return TextCorpus(texts=texts, metadata=None)

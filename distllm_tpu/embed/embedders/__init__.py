@@ -1,0 +1,42 @@
+"""Embedder strategy factory (reference: ``distllm/embed/embedders/__init__.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.embed.embedders.base import Embedder, EmbedderResult
+from distllm_tpu.embed.embedders.full_sequence import (
+    FullSequenceEmbedder,
+    FullSequenceEmbedderConfig,
+)
+from distllm_tpu.embed.embedders.semantic_chunk import (
+    SemanticChunkEmbedder,
+    SemanticChunkEmbedderConfig,
+)
+
+EmbedderConfigs = Union[FullSequenceEmbedderConfig, SemanticChunkEmbedderConfig]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'full_sequence': (FullSequenceEmbedderConfig, FullSequenceEmbedder),
+    'semantic_chunk': (SemanticChunkEmbedderConfig, SemanticChunkEmbedder),
+}
+
+
+def get_embedder(kwargs: dict[str, Any]) -> Embedder:
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown embedder name: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+__all__ = [
+    'Embedder',
+    'EmbedderResult',
+    'EmbedderConfigs',
+    'get_embedder',
+    'STRATEGIES',
+]

@@ -1,0 +1,37 @@
+"""Embedder protocol and result container.
+
+Reference parity: ``distllm/embed/embedders/base.py:17-58``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol, runtime_checkable
+
+import numpy as np
+
+from distllm_tpu.embed.datasets.base import TextCorpus
+from distllm_tpu.embed.encoders.base import Encoder
+from distllm_tpu.embed.poolers.base import Pooler
+
+
+@dataclass
+class EmbedderResult:
+    """Pooled embeddings ``[N, H]`` with aligned texts and metadata."""
+
+    embeddings: np.ndarray
+    text: list[str]
+    metadata: list[dict] | None = None
+
+
+@runtime_checkable
+class Embedder(Protocol):
+    config: object
+
+    def embed(
+        self,
+        corpus: TextCorpus,
+        encoder: Encoder,
+        pooler: Pooler,
+        batch_size: int,
+    ) -> EmbedderResult: ...

@@ -1,0 +1,82 @@
+"""Full-sequence embedder — the hot loop of the embed pipeline.
+
+Reference parity: ``distllm/embed/embedders/full_sequence.py:20-80`` — a
+preallocated host ``[N, H]`` buffer filled batch by batch. TPU adaptations:
+
+- texts are sorted by whitespace length and restored afterwards, so each
+  bucketed batch wastes minimal padding (the reference's Retriever does this
+  for queries, ``rag/search.py:800-836``; we apply it to the hot loop too);
+- partial final batches are padded to the fixed batch size with fully-masked
+  rows (jit re-specializes on batch shape otherwise);
+- encode+pool+normalize stay on device; only pooled ``[B, H]`` rows transfer
+  to host per batch (vs per-batch ``[B, S, H]`` ``.cpu()`` in torch).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax.numpy as jnp
+import numpy as np
+from pydantic import Field
+
+from distllm_tpu.embed.datasets.base import TextCorpus
+from distllm_tpu.embed.embedders.base import EmbedderResult
+from distllm_tpu.embed.encoders.base import Encoder
+from distllm_tpu.embed.poolers.base import Pooler
+from distllm_tpu.utils import BaseConfig
+
+
+def compute_embeddings(
+    texts: list[str],
+    encoder: Encoder,
+    pooler: Pooler,
+    batch_size: int,
+    normalize: bool = False,
+) -> np.ndarray:
+    """Embed ``texts`` → host ``[N, H]`` float32 array in original order."""
+    n = len(texts)
+    out = np.empty((n, encoder.embedding_size), dtype=np.float32)
+    if n == 0:
+        return out
+    order = sorted(range(n), key=lambda i: len(texts[i].split()))
+    for lo in range(0, n, batch_size):
+        idx = order[lo : lo + batch_size]
+        batch = encoder.tokenizer([texts[i] for i in idx])
+        batch = batch.pad_batch_to(batch_size, pad_id=encoder.tokenizer.pad_id)
+        hidden = encoder.forward(batch)
+        pooled = pooler.pool(hidden, batch.attention_mask)
+        if normalize:
+            pooled = pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        out[idx] = np.asarray(pooled, dtype=np.float32)[: len(idx)]
+    return out
+
+
+class FullSequenceEmbedderConfig(BaseConfig):
+    name: Literal['full_sequence'] = 'full_sequence'
+    normalize_embeddings: bool = Field(
+        default=False, description='L2-normalize pooled embeddings.'
+    )
+
+
+class FullSequenceEmbedder:
+    def __init__(self, config: FullSequenceEmbedderConfig) -> None:
+        self.config = config
+
+    def embed(
+        self,
+        corpus: TextCorpus,
+        encoder: Encoder,
+        pooler: Pooler,
+        batch_size: int,
+    ) -> EmbedderResult:
+        embeddings = compute_embeddings(
+            corpus.texts,
+            encoder,
+            pooler,
+            batch_size,
+            normalize=self.config.normalize_embeddings,
+        )
+        return EmbedderResult(
+            embeddings=embeddings, text=corpus.texts, metadata=corpus.metadata
+        )

@@ -1,0 +1,162 @@
+"""Semantic-chunk embedder: two-pass chunking at embedding-distance breakpoints.
+
+Reference parity: ``distllm/embed/embedders/semantic_chunk.py`` (itself
+adapted from llama-index's semantic splitter): (1) embed sentence buffers;
+(2) within each document (grouped by consecutive equal metadata ``path``),
+compute cosine distances between consecutive buffers in fp32, split at the
+``breakpoint_percentile_threshold`` percentile, join each group's
+``sentence`` strings into chunks, drop chunks ``<= min_chunk_length`` chars;
+(3) re-embed the chunks with ``chunk_batch_size``. Distance math is
+vectorized (the reference loops per pair, ``semantic_chunk.py:44-55``).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import numpy as np
+from pydantic import Field
+
+from distllm_tpu.embed.datasets.base import TextCorpus
+from distllm_tpu.embed.embedders.base import EmbedderResult
+from distllm_tpu.embed.embedders.full_sequence import compute_embeddings
+from distllm_tpu.embed.encoders.base import Encoder
+from distllm_tpu.embed.poolers.base import Pooler
+from distllm_tpu.utils import BaseConfig
+
+
+def calculate_distances_between_buffer(buffer_embeds: np.ndarray) -> np.ndarray:
+    """Cosine distances between consecutive rows, computed in fp32."""
+    x = buffer_embeds.astype(np.float32)
+    if len(x) < 2:
+        return np.zeros(0, dtype=np.float32)
+    a, b = x[:-1], x[1:]
+    sims = np.sum(a * b, axis=1) / (
+        np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1)
+    )
+    return 1.0 - sims
+
+
+def build_chunks(
+    distances: np.ndarray, breakpoint_percentile_threshold: int
+) -> list[tuple[int, int]]:
+    """Half-open-ish index groups [(start, end)] per reference semantics.
+
+    ``end`` is inclusive of the buffer at that index when slicing
+    ``metadata[start:end]`` (the reference returns ``(0, 0)`` for
+    single-buffer docs, yielding an empty slice — preserved here).
+    """
+    if len(distances) == 0:
+        return [(0, 0)]
+    threshold = np.percentile(distances, breakpoint_percentile_threshold)
+    above = [i for i, d in enumerate(distances) if d > threshold]
+    groups = []
+    start = 0
+    for idx in above:
+        groups.append((start, idx + 1))
+        start = idx + 1
+    groups.append((start, len(distances) + 1))
+    return groups
+
+
+def _document_spans(metadata: list[dict]) -> list[tuple[int, int]]:
+    """Consecutive runs of equal ``path`` → [(start, end)] spans."""
+    spans = []
+    start = 0
+    current = metadata[0]['path']
+    for i, meta in enumerate(metadata):
+        if meta['path'] != current:
+            spans.append((start, i))
+            start = i
+            current = meta['path']
+    spans.append((start, len(metadata)))
+    return spans
+
+
+def compute_semantic_chunks(
+    corpus: TextCorpus,
+    encoder: Encoder,
+    pooler: Pooler,
+    batch_size: int,
+    breakpoint_percentile_threshold: int,
+    min_chunk_length: int,
+) -> TextCorpus:
+    """First pass: buffer embeddings → chunk texts + metadata."""
+    if corpus.metadata is None:
+        raise ValueError('Metadata is required for semantic chunking.')
+    if corpus.metadata[0].get('path') is None:
+        raise ValueError('Metadata path is required for semantic chunking.')
+
+    buffer_embeds = compute_embeddings(corpus.texts, encoder, pooler, batch_size)
+
+    dataset_indices: list[tuple[int, int]] = []
+    for doc_start, doc_end in _document_spans(corpus.metadata):
+        distances = calculate_distances_between_buffer(
+            buffer_embeds[doc_start:doc_end]
+        )
+        for start, end in build_chunks(distances, breakpoint_percentile_threshold):
+            dataset_indices.append((doc_start + start, doc_start + end))
+
+    chunks: list[str] = []
+    metadata: list[dict] = []
+    for start, end in dataset_indices:
+        group = corpus.metadata[start:end]
+        chunk = ''.join(g['sentence'] for g in group)
+        if len(chunk) <= min_chunk_length:
+            continue
+        chunks.append(chunk)
+        meta = dict(corpus.metadata[start])
+        meta.pop('sentence', None)
+        metadata.append(meta)
+    return TextCorpus(chunks, metadata)
+
+
+class SemanticChunkEmbedderConfig(BaseConfig):
+    name: Literal['semantic_chunk'] = 'semantic_chunk'
+    breakpoint_percentile_threshold: int = Field(
+        default=90,
+        description='Cosine-dissimilarity percentile that must be exceeded '
+        'between consecutive sentence groups to start a new chunk; smaller '
+        'values produce more chunks.',
+    )
+    chunk_batch_size: int = Field(
+        default=8, description='Batch size for the second (chunk) pass.'
+    )
+    min_chunk_length: int = Field(
+        default=750,
+        description='Chunks with fewer characters are dropped.',
+    )
+    normalize_embeddings: bool = False
+
+
+class SemanticChunkEmbedder:
+    def __init__(self, config: SemanticChunkEmbedderConfig) -> None:
+        self.config = config
+
+    def embed(
+        self,
+        corpus: TextCorpus,
+        encoder: Encoder,
+        pooler: Pooler,
+        batch_size: int,
+    ) -> EmbedderResult:
+        chunked = compute_semantic_chunks(
+            corpus,
+            encoder,
+            pooler,
+            batch_size,
+            self.config.breakpoint_percentile_threshold,
+            self.config.min_chunk_length,
+        )
+        embeddings = compute_embeddings(
+            chunked.texts,
+            encoder,
+            pooler,
+            self.config.chunk_batch_size,
+            normalize=self.config.normalize_embeddings,
+        )
+        return EmbedderResult(
+            embeddings=embeddings,
+            text=chunked.texts,
+            metadata=chunked.metadata,
+        )

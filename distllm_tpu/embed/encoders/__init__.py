@@ -1,0 +1,62 @@
+"""Encoder strategy factory with optional warmstart registration.
+
+Reference parity: ``distllm/embed/encoders/__init__.py:34-84`` — pass
+``register=True`` to keep the (expensive) encoder cached across work items in
+persistent workers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.embed.encoders.auto import AutoEncoder, AutoEncoderConfig
+from distllm_tpu.embed.encoders.base import Encoder, JaxEncoder
+from distllm_tpu.embed.encoders.esm2 import (
+    Esm2Encoder,
+    Esm2EncoderConfig,
+    EsmCambrianEncoder,
+    EsmCambrianEncoderConfig,
+)
+from distllm_tpu.embed.encoders.fake import FakeEncoder, FakeEncoderConfig
+from distllm_tpu.registry import registry
+
+EncoderConfigs = Union[
+    AutoEncoderConfig,
+    Esm2EncoderConfig,
+    EsmCambrianEncoderConfig,
+    FakeEncoderConfig,
+]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'auto': (AutoEncoderConfig, AutoEncoder),
+    'esm2': (Esm2EncoderConfig, Esm2Encoder),
+    'esmc': (EsmCambrianEncoderConfig, EsmCambrianEncoder),
+    'fake': (FakeEncoderConfig, FakeEncoder),
+}
+
+
+def _build_encoder(**kwargs: Any) -> Encoder:
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown encoder name: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+def get_encoder(kwargs: dict[str, Any], register: bool = False) -> Encoder:
+    """Build an encoder; with ``register=True`` reuse a cached instance."""
+    if register:
+        return registry().get(_build_encoder, slot='encoder', **kwargs)
+    return _build_encoder(**kwargs)
+
+
+__all__ = [
+    'Encoder',
+    'JaxEncoder',
+    'EncoderConfigs',
+    'get_encoder',
+    'STRATEGIES',
+]

@@ -1,0 +1,82 @@
+"""Auto encoder: dispatch a local HF checkpoint to the right JAX model.
+
+Reference parity: ``distllm/embed/encoders/auto.py`` (``AutoModel`` with
+half precision, optional NF4 quantization, ``torch.compile``). Here the
+``model_type`` in ``config.json`` picks the JAX implementation (BERT-family
+or Mistral-family); precision is a dtype on the model config (bf16 default —
+the TPU-native analogue of ``half_precision``); compilation is jit, cached
+per bucket shape. Weight quantization (int8) arrives via
+``distllm_tpu.ops.quantization``.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from pydantic import Field
+
+from distllm_tpu.embed.encoders.base import JaxEncoder
+from distllm_tpu.models import bert, esm2, mistral
+from distllm_tpu.models.loader import read_checkpoint, read_hf_config
+from distllm_tpu.models.tokenizer import HFTokenizer
+from distllm_tpu.utils import BaseConfig
+
+_FAMILIES = {
+    'bert': (bert.BertConfig, bert),
+    'mistral': (mistral.MistralConfig, mistral),
+    'llama': (mistral.MistralConfig, mistral),
+    'esm': (esm2.Esm2Config, esm2),
+}
+
+
+class AutoEncoderConfig(BaseConfig):
+    name: Literal['auto'] = 'auto'
+    pretrained_model_name_or_path: str = Field(
+        description='Local path to an HF-format checkpoint directory.'
+    )
+    tokenizer_name: str | None = Field(
+        default=None, description='Defaults to the model path.'
+    )
+    half_precision: bool = Field(
+        default=True, description='bf16 activations/params (TPU-native).'
+    )
+    model_max_length: int | None = None
+    trust_remote_code: bool = False
+
+
+class AutoEncoder(JaxEncoder):
+    def __init__(self, config: AutoEncoderConfig) -> None:
+        hf_cfg = read_hf_config(config.pretrained_model_name_or_path)
+        model_type = hf_cfg.get('model_type', 'bert')
+        family = _FAMILIES.get(model_type)
+        if family is None:
+            raise ValueError(
+                f'Unsupported model_type {model_type!r}; '
+                f'supported: {sorted(_FAMILIES)}'
+            )
+        cfg_cls, module = family
+        model_cfg = cfg_cls.from_hf_config(hf_cfg)
+        model_cfg.dtype = 'bfloat16' if config.half_precision else 'float32'
+        state = read_checkpoint(config.pretrained_model_name_or_path)
+        params = module.params_from_hf(state, model_cfg)
+        tokenizer = HFTokenizer(
+            config.tokenizer_name or config.pretrained_model_name_or_path,
+            model_max_length=config.model_max_length
+            or hf_cfg.get('max_position_embeddings'),
+            trust_remote_code=config.trust_remote_code,
+        )
+        super().__init__(
+            config=config,
+            apply_fn=module.apply,
+            model_cfg=model_cfg,
+            params=params,
+            tokenizer=tokenizer,
+            embedding_size=model_cfg.hidden_size,
+        )
+        self._module = module
+
+    def param_specs(self, params=None):
+        try:
+            return self._module.param_specs(self.model_cfg, params or self.params)
+        except TypeError:
+            return self._module.param_specs(self.model_cfg)

@@ -1,0 +1,76 @@
+"""Encoder protocol and the shared JAX encoder runtime.
+
+Reference parity: ``distllm/embed/encoders/base.py:14-55`` — an encoder owns
+a tokenizer and produces ``[B, S, H]`` last hidden states. Here the forward
+is a jitted pure function cached per bucket shape; params can be sharded over
+a mesh for tensor parallelism (the reference's GPU equivalent relies on
+``torch.compile`` + CUDA, ``auto.py:92-93``).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+
+from distllm_tpu.models.tokenizer import TokenBatch
+
+
+@runtime_checkable
+class Encoder(Protocol):
+    config: object
+    embedding_size: int
+
+    @property
+    def tokenizer(self): ...
+
+    def forward(self, batch: TokenBatch) -> jnp.ndarray: ...
+
+
+class JaxEncoder:
+    """Concrete encoder driving a functional model's ``apply``.
+
+    ``apply_fn(params, model_cfg, ids, mask) -> [B, S, H]`` is jitted once
+    per input shape; bucketed tokenization keeps the set of shapes small.
+    """
+
+    def __init__(
+        self,
+        config,
+        apply_fn,
+        model_cfg,
+        params,
+        tokenizer,
+        embedding_size: int,
+    ) -> None:
+        self.config = config
+        self.model_cfg = model_cfg
+        self.params = params
+        self._tokenizer = tokenizer
+        self.embedding_size = embedding_size
+        self._forward = jax.jit(
+            lambda p, ids, mask: apply_fn(p, model_cfg, ids, mask)
+        )
+
+    @property
+    def tokenizer(self):
+        return self._tokenizer
+
+    @property
+    def dtype(self):
+        return jnp.dtype(getattr(self.model_cfg, 'dtype', 'float32'))
+
+    def forward(self, batch: TokenBatch) -> jnp.ndarray:
+        return self._forward(self.params, batch.input_ids, batch.attention_mask)
+
+    def shard(self, mesh, specs) -> None:
+        """Place params on a mesh (TP/DP); jitted fns re-specialize lazily."""
+        from distllm_tpu.parallel.sharding import shard_pytree
+
+        self.params = shard_pytree(self.params, specs, mesh)
+
+    def shutdown(self) -> None:
+        """Release HBM references so a swapped-in model can fit."""
+        self.params = None
+        self._forward = None

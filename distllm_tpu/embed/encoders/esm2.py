@@ -1,0 +1,88 @@
+"""ESM-2 protein encoder strategy.
+
+Reference parity: ``distllm/embed/encoders/esm2.py`` — the reference needs
+faesm/flash-attn CUDA kernels for speed with a transformers fallback; on TPU
+the fused attention comes from XLA, so there is a single code path.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from distllm_tpu.embed.encoders.base import JaxEncoder
+from distllm_tpu.models import esm2
+from distllm_tpu.models.loader import read_checkpoint, read_hf_config
+from distllm_tpu.models.tokenizer import HFTokenizer
+from distllm_tpu.utils import BaseConfig
+
+
+class Esm2EncoderConfig(BaseConfig):
+    name: Literal['esm2'] = 'esm2'
+    pretrained_model_name_or_path: str
+    half_precision: bool = True
+    model_max_length: int = 1024
+
+
+class Esm2Encoder(JaxEncoder):
+    def __init__(self, config: Esm2EncoderConfig) -> None:
+        hf_cfg = read_hf_config(config.pretrained_model_name_or_path)
+        model_cfg = esm2.Esm2Config.from_hf_config(hf_cfg)
+        model_cfg.dtype = 'bfloat16' if config.half_precision else 'float32'
+        params = esm2.params_from_hf(
+            read_checkpoint(config.pretrained_model_name_or_path), model_cfg
+        )
+        tokenizer = HFTokenizer(
+            config.pretrained_model_name_or_path,
+            model_max_length=config.model_max_length,
+        )
+        super().__init__(
+            config=config,
+            apply_fn=esm2.apply,
+            model_cfg=model_cfg,
+            params=params,
+            tokenizer=tokenizer,
+            embedding_size=model_cfg.hidden_size,
+        )
+
+
+class EsmCambrianEncoderConfig(BaseConfig):
+    """ESM-Cambrian (reference: ``embed/encoders/esmc.py``).
+
+    The reference validates the two released ESM-C sizes (960/1152 hidden)
+    and caps sequences at 2048 tokens; this port accepts HF-format ESM
+    checkpoints with those dims.
+    """
+
+    name: Literal['esmc'] = 'esmc'
+    pretrained_model_name_or_path: str
+    half_precision: bool = True
+    model_max_length: int = 2048
+
+
+class EsmCambrianEncoder(JaxEncoder):
+    VALID_HIDDEN_SIZES = (960, 1152)
+
+    def __init__(self, config: EsmCambrianEncoderConfig) -> None:
+        hf_cfg = read_hf_config(config.pretrained_model_name_or_path)
+        model_cfg = esm2.Esm2Config.from_hf_config(hf_cfg)
+        if model_cfg.hidden_size not in self.VALID_HIDDEN_SIZES:
+            raise ValueError(
+                f'ESM-C checkpoints have hidden size in '
+                f'{self.VALID_HIDDEN_SIZES}, got {model_cfg.hidden_size}'
+            )
+        model_cfg.dtype = 'bfloat16' if config.half_precision else 'float32'
+        params = esm2.params_from_hf(
+            read_checkpoint(config.pretrained_model_name_or_path), model_cfg
+        )
+        tokenizer = HFTokenizer(
+            config.pretrained_model_name_or_path,
+            model_max_length=config.model_max_length,
+        )
+        super().__init__(
+            config=config,
+            apply_fn=esm2.apply,
+            model_cfg=model_cfg,
+            params=params,
+            tokenizer=tokenizer,
+            embedding_size=model_cfg.hidden_size,
+        )

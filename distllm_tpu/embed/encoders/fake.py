@@ -1,0 +1,54 @@
+"""Deterministic fake encoder for tests and pipeline benchmarks.
+
+The reference has no fake backends (SURVEY.md section 4 flags this as a gap):
+small real models stand in, which requires downloads. This encoder is fully
+local: a fixed PRNG embedding table indexed by token id, so outputs are
+reproducible across processes and platforms.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from distllm_tpu.models.tokenizer import TokenBatch, WhitespaceTokenizer
+from distllm_tpu.utils import BaseConfig
+
+
+class FakeEncoderConfig(BaseConfig):
+    name: Literal['fake'] = 'fake'
+    embedding_size: int = 64
+    vocab_size: int = 4096
+    model_max_length: int = 128
+    seed: int = 0
+
+
+class FakeEncoder:
+    def __init__(self, config: FakeEncoderConfig) -> None:
+        self.config = config
+        self.embedding_size = config.embedding_size
+        self._tokenizer = WhitespaceTokenizer(
+            vocab_size=config.vocab_size,
+            model_max_length=config.model_max_length,
+        )
+        self._table = jax.random.normal(
+            jax.random.PRNGKey(config.seed),
+            (config.vocab_size, config.embedding_size),
+            dtype=jnp.float32,
+        )
+
+    @property
+    def tokenizer(self) -> WhitespaceTokenizer:
+        return self._tokenizer
+
+    @property
+    def dtype(self):
+        return jnp.float32
+
+    def forward(self, batch: TokenBatch) -> jnp.ndarray:
+        return self._table[jnp.asarray(batch.input_ids)]
+
+    def shutdown(self) -> None:
+        self._table = None

@@ -1,0 +1,33 @@
+"""Pooler strategy factory (reference: ``distllm/embed/poolers/__init__.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.embed.poolers.base import Pooler
+from distllm_tpu.embed.poolers.last_token import (
+    LastTokenPooler,
+    LastTokenPoolerConfig,
+)
+from distllm_tpu.embed.poolers.mean import MeanPooler, MeanPoolerConfig
+
+PoolerConfigs = Union[MeanPoolerConfig, LastTokenPoolerConfig]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'mean': (MeanPoolerConfig, MeanPooler),
+    'last_token': (LastTokenPoolerConfig, LastTokenPooler),
+}
+
+
+def get_pooler(kwargs: dict[str, Any]) -> Pooler:
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown pooler name: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+__all__ = ['Pooler', 'PoolerConfigs', 'get_pooler', 'STRATEGIES']

@@ -1,0 +1,20 @@
+"""Pooler protocol: ``[B, S, H]`` hidden states + ``[B, S]`` mask → ``[B, H]``.
+
+Reference parity: ``distllm/embed/poolers/base.py:12-42``; here ``pool`` is a
+jitted JAX op operating on device arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+import jax.numpy as jnp
+
+
+@runtime_checkable
+class Pooler(Protocol):
+    config: object
+
+    def pool(
+        self, embeddings: jnp.ndarray, attention_mask: jnp.ndarray
+    ) -> jnp.ndarray: ...

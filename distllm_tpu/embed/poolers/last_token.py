@@ -1,0 +1,44 @@
+"""Last-token pooler (SFR-Embedding-Mistral style).
+
+Reference parity: ``distllm/embed/poolers/last_token.py:30-39`` — if the
+batch is left-padded (every row's final position is valid) take position -1,
+otherwise gather each row's last valid token at ``mask.sum(1) - 1``.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from distllm_tpu.utils import BaseConfig
+
+
+@jax.jit
+def last_token_pool(
+    last_hidden_states: jnp.ndarray, attention_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """``[B, S, H]`` → ``[B, H]`` last valid token per row."""
+    batch = last_hidden_states.shape[0]
+    left_padded = jnp.sum(attention_mask[:, -1]) == batch
+    lengths = jnp.sum(attention_mask, axis=1)
+    gather_idx = jnp.clip(lengths - 1, min=0)
+    gathered = last_hidden_states[jnp.arange(batch), gather_idx]
+    return jnp.where(
+        left_padded, last_hidden_states[:, -1], gathered
+    ).astype(jnp.float32)
+
+
+class LastTokenPoolerConfig(BaseConfig):
+    name: Literal['last_token'] = 'last_token'
+
+
+class LastTokenPooler:
+    def __init__(self, config: LastTokenPoolerConfig) -> None:
+        self.config = config
+
+    def pool(
+        self, embeddings: jnp.ndarray, attention_mask: jnp.ndarray
+    ) -> jnp.ndarray:
+        return last_token_pool(embeddings, jnp.asarray(attention_mask))

@@ -1,0 +1,55 @@
+"""Mean pooler: masked average excluding start and end special tokens.
+
+Reference parity: ``distllm/embed/poolers/mean.py:13-49`` — average over
+valid positions with the [CLS]-position and final-token positions masked out
+and a clamped denominator. Deliberate fix over the reference: the reference's
+``attention_mask[:, seq_lengths - 1] = 0`` zeroes the *union* of every row's
+end-column across the whole batch (torch advanced indexing on the column
+axis); here the end token is excluded per row, which is the documented intent
+("does not include the pad, start, or end tokens"). The reference also
+mutates the caller's mask in place; this implementation is pure.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from distllm_tpu.utils import BaseConfig
+
+
+@jax.jit
+def average_pool(
+    embeddings: jnp.ndarray, attention_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Masked mean over interior tokens: ``[B, S, H]`` → ``[B, H]``."""
+    seq_len = attention_mask.shape[1]
+    positions = jnp.arange(seq_len)[None, :]
+    lengths = jnp.sum(attention_mask, axis=1, keepdims=True)
+    interior = (
+        attention_mask.astype(bool)
+        & (positions != 0)  # start token
+        & (positions != lengths - 1)  # per-row end token
+    )
+    weights = interior.astype(jnp.float32)[..., None]
+    summed = jnp.sum(embeddings.astype(jnp.float32) * weights, axis=1)
+    denom = jnp.clip(jnp.sum(weights, axis=1), min=1e-9)
+    return summed / denom
+
+
+class MeanPoolerConfig(BaseConfig):
+    name: Literal['mean'] = 'mean'
+
+
+class MeanPooler:
+    """Averages interior hidden states (no pad/start/end tokens)."""
+
+    def __init__(self, config: MeanPoolerConfig) -> None:
+        self.config = config
+
+    def pool(
+        self, embeddings: jnp.ndarray, attention_mask: jnp.ndarray
+    ) -> jnp.ndarray:
+        return average_pool(embeddings, jnp.asarray(attention_mask))

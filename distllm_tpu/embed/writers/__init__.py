@@ -1,0 +1,33 @@
+"""Writer strategy factory (reference: ``distllm/embed/writers/__init__.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.embed.writers.base import Writer
+from distllm_tpu.embed.writers.huggingface import (
+    HuggingFaceWriter,
+    HuggingFaceWriterConfig,
+)
+from distllm_tpu.embed.writers.numpy import NumpyWriter, NumpyWriterConfig
+
+WriterConfigs = Union[HuggingFaceWriterConfig, NumpyWriterConfig]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'huggingface': (HuggingFaceWriterConfig, HuggingFaceWriter),
+    'numpy': (NumpyWriterConfig, NumpyWriter),
+}
+
+
+def get_writer(kwargs: dict[str, Any]) -> Writer:
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown writer name: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+__all__ = ['Writer', 'WriterConfigs', 'get_writer', 'STRATEGIES']

@@ -1,0 +1,22 @@
+"""Writer protocol: persist EmbedderResults and merge shard outputs.
+
+Reference parity: ``distllm/embed/writers/base.py:12-41``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Protocol, runtime_checkable
+
+from distllm_tpu.embed.embedders.base import EmbedderResult
+
+
+@runtime_checkable
+class Writer(Protocol):
+    config: object
+
+    def write(self, output_dir: str | Path, result: EmbedderResult) -> None: ...
+
+    def merge(
+        self, dataset_dirs: list[str | Path], output_dir: str | Path
+    ) -> None: ...

@@ -1,0 +1,61 @@
+"""HuggingFace datasets writer.
+
+Reference parity: ``distllm/embed/writers/huggingface.py`` — builds the
+dataset from an in-memory list (the reference deliberately avoids
+``from_generator`` for NFS safety, ``:61-70``); ``merge`` loads every shard,
+concatenates, and saves with ``num_proc`` workers. Shards that are missing or
+corrupt are skipped with a warning (matching the generate-writer behavior the
+drivers rely on for partial re-runs).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Literal
+
+from pydantic import Field
+
+from distllm_tpu.embed.embedders.base import EmbedderResult
+from distllm_tpu.utils import BaseConfig
+
+
+class HuggingFaceWriterConfig(BaseConfig):
+    name: Literal['huggingface'] = 'huggingface'
+    num_proc: int | None = Field(
+        default=None, description='Workers for merge save_to_disk.'
+    )
+
+
+class HuggingFaceWriter:
+    def __init__(self, config: HuggingFaceWriterConfig) -> None:
+        self.config = config
+
+    def write(self, output_dir: str | Path, result: EmbedderResult) -> None:
+        from datasets import Dataset
+
+        rows: dict[str, list] = {
+            'text': list(result.text),
+            'embeddings': [e for e in result.embeddings],
+        }
+        if result.metadata:
+            keys = result.metadata[0].keys()
+            for key in keys:
+                rows[key] = [m.get(key) for m in result.metadata]
+        dataset = Dataset.from_dict(rows)
+        dataset.save_to_disk(str(output_dir))
+
+    def merge(
+        self, dataset_dirs: list[str | Path], output_dir: str | Path
+    ) -> None:
+        from datasets import concatenate_datasets, load_from_disk
+
+        shards = []
+        for path in dataset_dirs:
+            try:
+                shards.append(load_from_disk(str(path)))
+            except Exception as exc:  # noqa: BLE001 - skip bad shards
+                print(f'[writer] skipping shard {path}: {exc}')
+        if not shards:
+            raise ValueError(f'no readable shards among {len(dataset_dirs)} dirs')
+        merged = concatenate_datasets(shards)
+        merged.save_to_disk(str(output_dir), num_proc=self.config.num_proc)

@@ -1,0 +1,232 @@
+"""Cross-host work distribution fabric (ZMQ) — the Parsl-HTEX replacement.
+
+The reference scales out via Parsl's HighThroughputExecutor: a ZMQ/TCP task
+fabric shipping pickled worker functions to persistent per-GPU processes
+(``distllm/parsl.py``; SURVEY.md section 2.5 row N7). Parsl is not available
+here, and on TPU pods the right granularity is one worker process per *host*
+(a host owns all its chips through one JAX process) — so this module
+implements the same pattern directly:
+
+- :class:`Coordinator` — binds a ZMQ ROUTER socket, hands out (task_id, fn,
+  args) pickles to idle workers, collects results, retries on worker loss.
+- :class:`FabricWorker` — DEALER socket loop: request → execute → reply,
+  with a background heartbeat thread so long-running tasks (file embeds can
+  take many minutes) never get the worker falsely reaped.
+- :class:`ZmqPoolExecutor` — ``map(fn, items)`` facade over the coordinator
+  matching the in-process executors' API.
+
+Worker functions must be module-level (pickle), exactly as with Parsl.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket as _socket
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+_READY = b'READY'
+_HEARTBEAT = b'HB'
+_RESULT = b'RESULT'
+
+
+@dataclass
+class _Task:
+    task_id: bytes
+    payload: bytes
+    tries: int = 0
+
+
+@dataclass
+class _WorkerState:
+    ident: bytes
+    last_seen: float = field(default_factory=time.monotonic)
+    current: bytes | None = None
+
+
+class Coordinator:
+    """ROUTER-socket task pump with heartbeat-based failure detection.
+
+    Failure semantics mirror the reference's Parsl config: tasks are retried
+    up to ``retries`` times (``parsl.py:85,130,197``), and a worker silent for
+    ``heartbeat_threshold`` seconds is declared lost, its in-flight task
+    requeued (``parsl.py:216-217`` uses 15s/120s). Workers heartbeat during
+    task execution, so the threshold bounds *network* silence, not task
+    duration. A reaped worker that later reports its (requeued) task's result
+    is accepted if the task has not been re-dispatched yet.
+    """
+
+    def __init__(
+        self,
+        bind: str = 'tcp://*:0',
+        retries: int = 1,
+        heartbeat_threshold: float = 120.0,
+        advertise_host: str | None = None,
+    ) -> None:
+        import zmq
+
+        self._ctx = zmq.Context.instance()
+        self._socket = self._ctx.socket(zmq.ROUTER)
+        host = advertise_host or _socket.gethostname()
+        if bind.endswith(':0'):
+            port = self._socket.bind_to_random_port('tcp://*')
+            self.endpoint = f'tcp://{host}:{port}'
+        else:
+            self._socket.bind(bind)
+            self.endpoint = bind.replace('*', host)
+        self.retries = retries
+        self.heartbeat_threshold = heartbeat_threshold
+        self._workers: dict[bytes, _WorkerState] = {}
+
+    def run(self, tasks: list[_Task]) -> dict[bytes, Any]:
+        """Dispatch all tasks; block until every result (or failure) arrives."""
+        import zmq
+
+        pending: list[_Task] = list(tasks)
+        in_flight: dict[bytes, _Task] = {}
+        results: dict[bytes, Any] = {}
+        poller = zmq.Poller()
+        poller.register(self._socket, zmq.POLLIN)
+
+        def record(task: _Task, ok: bytes, payload: bytes) -> None:
+            if ok == b'1':
+                results[task.task_id] = pickle.loads(payload)
+            elif task.tries <= self.retries:
+                pending.append(task)
+            else:
+                results[task.task_id] = pickle.loads(payload)
+
+        while len(results) < len(tasks):
+            self._reap_lost_workers(in_flight, pending)
+            events = dict(poller.poll(timeout=1000))
+            if self._socket not in events:
+                continue
+            frames = self._socket.recv_multipart()
+            ident, kind = frames[0], frames[1]
+            worker = self._workers.setdefault(ident, _WorkerState(ident))
+            worker.last_seen = time.monotonic()
+            if kind == _READY:
+                worker.current = None
+            elif kind == _RESULT:
+                task_id, ok, payload = frames[2], frames[3], frames[4]
+                worker.current = None
+                task = in_flight.pop(task_id, None)
+                if task is None:
+                    # Worker was reaped mid-task; accept the result if the
+                    # requeued copy hasn't been re-dispatched yet.
+                    for i, queued in enumerate(pending):
+                        if queued.task_id == task_id:
+                            pending.pop(i)
+                            task = queued
+                            break
+                if task is not None and task_id not in results:
+                    record(task, ok, payload)
+            # Dispatch on ANY message kind (READY, RESULT, or HB): a reaped
+            #-and-revived worker must be able to pick work back up even if
+            # its next frame is only a heartbeat.
+            if pending and worker.current is None:
+                task = pending.pop(0)
+                task.tries += 1
+                worker.current = task.task_id
+                in_flight[task.task_id] = task
+                self._socket.send_multipart([ident, task.task_id, task.payload])
+        return results
+
+    def _reap_lost_workers(
+        self, in_flight: dict[bytes, _Task], pending: list[_Task]
+    ) -> None:
+        now = time.monotonic()
+        for ident in list(self._workers):
+            worker = self._workers[ident]
+            if now - worker.last_seen > self.heartbeat_threshold:
+                if worker.current is not None:
+                    task = in_flight.pop(worker.current, None)
+                    if task is not None:
+                        pending.append(task)
+                del self._workers[ident]
+
+    def close(self) -> None:
+        self._socket.close(linger=0)
+
+
+class FabricWorker:
+    """Worker loop: announce READY, execute tasks, reply, heartbeat always.
+
+    The heartbeat runs on a background thread (ZMQ sockets are not
+    thread-safe, so all sends share a lock) and keeps flowing while the main
+    thread is blocked inside a long task — the coordinator therefore only
+    reaps on real network/process loss.
+    """
+
+    def __init__(self, coordinator: str, heartbeat_interval: float = 5.0) -> None:
+        import zmq
+
+        self._ctx = zmq.Context.instance()
+        self._socket = self._ctx.socket(zmq.DEALER)
+        self._socket.connect(coordinator)
+        self.heartbeat_interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._send_lock = threading.Lock()
+
+    def _send(self, frames: list[bytes]) -> None:
+        with self._send_lock:
+            self._socket.send_multipart(frames)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            self._send([_HEARTBEAT])
+
+    def run(self) -> None:
+        import zmq
+
+        hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        hb_thread.start()
+        poller = zmq.Poller()
+        poller.register(self._socket, zmq.POLLIN)
+        self._send([_READY])
+        while not self._stop.is_set():
+            events = dict(poller.poll(timeout=500))
+            if self._socket not in events:
+                continue
+            task_id, payload = self._socket.recv_multipart()
+            if not task_id:
+                continue
+            try:
+                fn, args, kwargs = pickle.loads(payload)
+                result = fn(*args, **kwargs)
+                self._send([_RESULT, task_id, b'1', pickle.dumps(result)])
+            except BaseException as exc:  # noqa: BLE001 - shipped to coordinator
+                self._send(
+                    [_RESULT, task_id, b'0', pickle.dumps(RuntimeError(repr(exc)))]
+                )
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ZmqPoolExecutor:
+    """``map`` facade over :class:`Coordinator` (ParslPoolExecutor parity)."""
+
+    def __init__(self, coordinator: Coordinator) -> None:
+        self.coordinator = coordinator
+
+    def map(self, fn: Callable, items: Iterable[Any]) -> list[Any]:
+        tasks = []
+        order = []
+        for item in items:
+            task_id = uuid.uuid4().bytes
+            order.append(task_id)
+            tasks.append(
+                _Task(task_id=task_id, payload=pickle.dumps((fn, (item,), {})))
+            )
+        results = self.coordinator.run(tasks)
+        out = []
+        for task_id in order:
+            value = results[task_id]
+            if isinstance(value, BaseException):
+                raise value
+            out.append(value)
+        return out

@@ -1,0 +1,121 @@
+"""Compute-platform configs: how workers are provisioned on each platform.
+
+Reference parity: ``distllm/parsl.py`` — ``BaseComputeConfig.get_config``
+returning a Parsl config for Local / Workstation / Polaris(PBS) /
+Leonardo(Slurm). Here the analogue is ``get_executor(run_dir)`` returning an
+object with ``.map(fn, items)``:
+
+- :class:`LocalConfig` — in-process serial executor ("mainly for testing",
+  ``parsl.py:49-73``); identical worker code path as the pod.
+- :class:`WorkstationConfig` — multiprocessing pool on one machine. On TPU a
+  host's chips belong to ONE JAX process (mesh-level parallelism inside),
+  unlike the reference's one-process-per-GPU, so ``max_workers`` defaults
+  to 1 and is only raised for CPU-bound pipelines (tokenization).
+- :class:`PodConfig` — ZMQ fabric coordinator for multi-host TPU pods; hosts
+  run ``python -m distllm_tpu.parallel.worker``. PBS/Slurm submission stays
+  outside (the scheduler script launches one worker per host), matching how
+  the reference's MpiExecLauncher starts one manager per node.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from pathlib import Path
+from typing import Any, Callable, Iterable, Literal, Union
+
+from pydantic import Field
+
+from distllm_tpu.utils import BaseConfig
+
+
+class SerialExecutor:
+    """Run tasks inline — the Local platform and the unit-test stand-in."""
+
+    def map(self, fn: Callable, items: Iterable[Any]) -> list[Any]:
+        return [fn(item) for item in items]
+
+
+class ProcessPoolMapExecutor:
+    """Spawn-based process pool for CPU-bound per-file work."""
+
+    def __init__(self, max_workers: int) -> None:
+        self.max_workers = max_workers
+
+    def map(self, fn: Callable, items: Iterable[Any]) -> list[Any]:
+        items = list(items)
+        if self.max_workers <= 1 or len(items) <= 1:
+            return [fn(item) for item in items]
+        ctx = mp.get_context('spawn')
+        with ctx.Pool(processes=self.max_workers) as pool:
+            return pool.map(fn, items)
+
+
+class LocalConfig(BaseConfig):
+    """Single in-process worker (testing / single host)."""
+
+    name: Literal['local'] = 'local'
+
+    def get_executor(self, run_dir: str | Path) -> SerialExecutor:
+        Path(run_dir).mkdir(parents=True, exist_ok=True)
+        return SerialExecutor()
+
+
+class WorkstationConfig(BaseConfig):
+    """Single machine, optional process pool (CPU-bound stages only)."""
+
+    name: Literal['workstation'] = 'workstation'
+    max_workers: int = Field(
+        default=1,
+        description='Worker processes. Keep 1 for TPU compute (one JAX '
+        'process owns the chips); raise for CPU-only pipelines.',
+    )
+
+    def get_executor(self, run_dir: str | Path) -> ProcessPoolMapExecutor:
+        Path(run_dir).mkdir(parents=True, exist_ok=True)
+        return ProcessPoolMapExecutor(self.max_workers)
+
+
+class PodConfig(BaseConfig):
+    """Multi-host TPU pod via the ZMQ fabric.
+
+    The coordinator binds ``bind_address`` and advertises
+    ``tcp://<advertise_host>:<port>`` (hostname by default) — workers on
+    other hosts pass that advertised endpoint to
+    ``python -m distllm_tpu.parallel.worker --coordinator ...``.
+    ``retries``/``heartbeat_threshold`` mirror the reference's Parsl retry +
+    heartbeat settings (``parsl.py:197,216-217``).
+    """
+
+    name: Literal['pod'] = 'pod'
+    bind_address: str = 'tcp://*:5555'
+    advertise_host: str | None = Field(
+        default=None,
+        description='Routable address workers should dial; defaults to '
+        'this hostname.',
+    )
+    retries: int = 1
+    heartbeat_threshold: float = 120.0
+
+    def get_executor(self, run_dir: str | Path):
+        from distllm_tpu.parallel.fabric import Coordinator, ZmqPoolExecutor
+
+        Path(run_dir).mkdir(parents=True, exist_ok=True)
+        coordinator = Coordinator(
+            bind=self.bind_address,
+            retries=self.retries,
+            heartbeat_threshold=self.heartbeat_threshold,
+            advertise_host=self.advertise_host,
+        )
+        print(f'[fabric] coordinator at {coordinator.endpoint}', flush=True)
+        return ZmqPoolExecutor(coordinator)
+
+
+ComputeConfigs = Union[LocalConfig, WorkstationConfig, PodConfig]
+
+
+def get_compute_config(kwargs: dict[str, Any]) -> ComputeConfigs:
+    name = kwargs.get('name', 'local')
+    for cls in (LocalConfig, WorkstationConfig, PodConfig):
+        if name == cls.model_fields['name'].default:
+            return cls(**kwargs)
+    raise ValueError(f'Unknown compute config name: {name!r}')

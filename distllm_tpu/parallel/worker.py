@@ -1,0 +1,32 @@
+"""Per-host fabric worker entry point.
+
+Launched once per TPU host by the cluster scheduler (PBS/Slurm script or ssh
+loop), analogous to Parsl's ``process_worker_pool`` that the reference's
+MpiExecLauncher starts per node (``distllm/parsl.py:227-230``)::
+
+    python -m distllm_tpu.parallel.worker --coordinator tcp://login-node:5555
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description='distllm-tpu fabric worker')
+    parser.add_argument('--coordinator', required=True, help='tcp://host:port')
+    parser.add_argument('--heartbeat-interval', type=float, default=5.0)
+    args = parser.parse_args(argv)
+
+    from distllm_tpu.parallel.fabric import FabricWorker
+
+    worker = FabricWorker(
+        args.coordinator, heartbeat_interval=args.heartbeat_interval
+    )
+    print(f'[worker] connected to {args.coordinator}', flush=True)
+    worker.run()
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

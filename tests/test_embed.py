@@ -1,0 +1,225 @@
+"""Embed pipeline tests: datasets, poolers, embedders, writers, end-to-end."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distllm_tpu.embed import (
+    get_dataset,
+    get_embedder,
+    get_encoder,
+    get_pooler,
+    get_writer,
+)
+from distllm_tpu.embed.embedders.full_sequence import compute_embeddings
+from distllm_tpu.embed.embedders.semantic_chunk import (
+    build_chunks,
+    calculate_distances_between_buffer,
+)
+from distllm_tpu.embed.poolers.last_token import last_token_pool
+from distllm_tpu.embed.poolers.mean import average_pool
+
+
+# ---------------------------------------------------------------- datasets
+def _write_jsonl(path, entries):
+    with open(path, 'w') as fh:
+        for e in entries:
+            fh.write(json.dumps(e) + '\n')
+
+
+def test_jsonl_dataset(tmp_path):
+    f = tmp_path / 'data.jsonl'
+    _write_jsonl(f, [{'text': 'hello', 'path': 'a'}, {'text': 'world', 'path': 'b'}])
+    ds = get_dataset({'name': 'jsonl'})
+    corpus = ds.read(f)
+    assert corpus.texts == ['hello', 'world']
+    assert corpus.metadata == [{'path': 'a'}, {'path': 'b'}]
+
+
+def test_jsonl_chunk_dataset(tmp_path):
+    text = (
+        'Machine learning is great. ' * 3
+        + 'Bananas are yellow fruit. ' * 3
+    )
+    f = tmp_path / 'd.jsonl'
+    _write_jsonl(f, [{'text': text, 'path': 'doc1'}])
+    ds = get_dataset({'name': 'jsonl_chunk', 'min_buffer_length': 10, 'buffer_size': 1})
+    corpus = ds.read(f)
+    assert len(corpus) > 0
+    # every buffer carries the source sentence + original metadata
+    assert all('sentence' in m and m['path'] == 'doc1' for m in corpus.metadata)
+    # buffers are windows, so interior buffers span >= their own sentence
+    assert all(len(t) >= len(m['sentence']) for t, m in zip(corpus.texts, corpus.metadata))
+
+
+def test_fasta_dataset(tmp_path):
+    f = tmp_path / 'seqs.fasta'
+    f.write_text('>seq1 desc\nacgt\nACGT\n>seq2\nmkvl\n')
+    corpus = get_dataset({'name': 'fasta'}).read(f)
+    assert corpus.texts == ['ACGTACGT', 'MKVL']
+    assert corpus.metadata[0]['tags'] == 'seq1 desc'
+
+
+def test_sequence_per_line_dataset(tmp_path):
+    f = tmp_path / 'lines.txt'
+    f.write_text('header\nAAA\nBBB\n\n')
+    corpus = get_dataset({'name': 'sequence_per_line', 'header_lines': 1}).read(f)
+    assert corpus.texts == ['AAA', 'BBB']
+
+
+def test_huggingface_dataset(tmp_path):
+    from datasets import Dataset
+
+    Dataset.from_dict({'text': ['x', 'y'], 'path': ['p1', 'p2']}).save_to_disk(
+        str(tmp_path / 'hf')
+    )
+    corpus = get_dataset(
+        {'name': 'huggingface', 'metadata_fields': ['path']}
+    ).read(tmp_path / 'hf')
+    assert corpus.texts == ['x', 'y']
+    assert corpus.metadata == [{'path': 'p1'}, {'path': 'p2'}]
+
+
+def test_unknown_strategy():
+    with pytest.raises(ValueError, match='Unknown dataset'):
+        get_dataset({'name': 'bogus'})
+
+
+# ---------------------------------------------------------------- poolers
+def test_average_pool_excludes_start_end_per_row():
+    # Row 0: valid length 4 -> interior tokens at positions 1, 2
+    # Row 1: valid length 3 -> interior token at position 1
+    hidden = jnp.arange(2 * 5 * 2, dtype=jnp.float32).reshape(2, 5, 2)
+    mask = jnp.array([[1, 1, 1, 1, 0], [1, 1, 1, 0, 0]])
+    pooled = np.asarray(average_pool(hidden, mask))
+    expected0 = np.asarray(hidden[0, 1:3]).mean(axis=0)
+    expected1 = np.asarray(hidden[1, 1:2]).mean(axis=0)
+    np.testing.assert_allclose(pooled[0], expected0)
+    np.testing.assert_allclose(pooled[1], expected1)
+
+
+def test_average_pool_zero_length_no_nan():
+    hidden = jnp.ones((1, 4, 3))
+    mask = jnp.zeros((1, 4), dtype=jnp.int32)
+    pooled = np.asarray(average_pool(hidden, mask))
+    assert np.isfinite(pooled).all()
+
+
+def test_last_token_pool_right_padded():
+    hidden = jnp.arange(2 * 4 * 2, dtype=jnp.float32).reshape(2, 4, 2)
+    mask = jnp.array([[1, 1, 1, 0], [1, 1, 1, 1]])
+    pooled = np.asarray(last_token_pool(hidden, mask))
+    np.testing.assert_allclose(pooled[0], np.asarray(hidden[0, 2]))
+    np.testing.assert_allclose(pooled[1], np.asarray(hidden[1, 3]))
+
+
+def test_last_token_pool_left_padded():
+    hidden = jnp.arange(2 * 4 * 2, dtype=jnp.float32).reshape(2, 4, 2)
+    mask = jnp.array([[0, 1, 1, 1], [1, 1, 1, 1]])
+    pooled = np.asarray(last_token_pool(hidden, mask))
+    np.testing.assert_allclose(pooled[0], np.asarray(hidden[0, 3]))
+    np.testing.assert_allclose(pooled[1], np.asarray(hidden[1, 3]))
+
+
+# ------------------------------------------------------------- embedders
+def test_compute_embeddings_order_and_determinism():
+    encoder = get_encoder({'name': 'fake', 'embedding_size': 16})
+    pooler = get_pooler({'name': 'mean'})
+    texts = ['one two three', 'a much longer text with many more words here', 'x']
+    out1 = compute_embeddings(texts, encoder, pooler, batch_size=2)
+    out2 = compute_embeddings(texts, encoder, pooler, batch_size=3)
+    assert out1.shape == (3, 16)
+    # batch size must not change results (order restoration works)
+    np.testing.assert_allclose(out1, out2, atol=1e-5)
+
+
+def test_compute_embeddings_normalized():
+    encoder = get_encoder({'name': 'fake', 'embedding_size': 8})
+    pooler = get_pooler({'name': 'mean'})
+    out = compute_embeddings(['hello world foo', 'bar baz'], encoder, pooler, 2, normalize=True)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, atol=1e-5)
+
+
+def test_distances_and_chunk_building():
+    embeds = np.array([[1, 0], [1, 0.01], [0, 1], [0, 1.01]], dtype=np.float32)
+    d = calculate_distances_between_buffer(embeds)
+    assert len(d) == 3
+    assert d[1] > d[0] and d[1] > d[2]  # breakpoint in the middle
+    groups = build_chunks(d, breakpoint_percentile_threshold=50)
+    assert groups[0] == (0, 2)
+    assert groups[-1][1] == len(d) + 1
+    assert build_chunks(np.zeros(0), 90) == [(0, 0)]
+
+
+def test_semantic_chunk_embedder_end_to_end(tmp_path):
+    rng = np.random.default_rng(0)
+    sents_a = ['alpha beta gamma delta. '] * 4
+    sents_b = ['totally different subject matter now. '] * 4
+    text = ''.join(sents_a + sents_b)
+    f = tmp_path / 'doc.jsonl'
+    _write_jsonl(f, [{'text': text, 'path': 'docA'}])
+    corpus = get_dataset(
+        {'name': 'jsonl_chunk', 'min_buffer_length': 5, 'buffer_size': 1}
+    ).read(f)
+    encoder = get_encoder({'name': 'fake', 'embedding_size': 32})
+    pooler = get_pooler({'name': 'mean'})
+    embedder = get_embedder(
+        {'name': 'semantic_chunk', 'min_chunk_length': 10, 'chunk_batch_size': 4}
+    )
+    result = embedder.embed(corpus, encoder, pooler, batch_size=4)
+    assert len(result.text) == len(result.embeddings)
+    assert result.embeddings.shape[1] == 32
+    assert all('sentence' not in m for m in result.metadata)
+    assert all(m['path'] == 'docA' for m in result.metadata)
+
+
+# ---------------------------------------------------------------- writers
+def _small_result():
+    from distllm_tpu.embed.embedders.base import EmbedderResult
+
+    return EmbedderResult(
+        embeddings=np.arange(6, dtype=np.float32).reshape(2, 3),
+        text=['t1', 't2'],
+        metadata=[{'path': 'a'}, {'path': 'b'}],
+    )
+
+
+def test_numpy_writer_roundtrip_and_merge(tmp_path):
+    writer = get_writer({'name': 'numpy'})
+    writer.write(tmp_path / 's1', _small_result())
+    writer.write(tmp_path / 's2', _small_result())
+    writer.merge([tmp_path / 's1', tmp_path / 's2'], tmp_path / 'merged')
+    merged = np.load(tmp_path / 'merged' / 'embeddings.npy')
+    assert merged.shape == (4, 3)
+    texts = np.load(tmp_path / 'merged' / 'text.npy', allow_pickle=True)
+    assert list(texts) == ['t1', 't2', 't1', 't2']
+
+
+def test_huggingface_writer_roundtrip_and_merge(tmp_path):
+    from datasets import load_from_disk
+
+    writer = get_writer({'name': 'huggingface'})
+    writer.write(tmp_path / 's1', _small_result())
+    writer.write(tmp_path / 's2', _small_result())
+    writer.merge(
+        [tmp_path / 's1', tmp_path / 's2', tmp_path / 'missing'],
+        tmp_path / 'merged',
+    )
+    ds = load_from_disk(str(tmp_path / 'merged'))
+    assert len(ds) == 4
+    assert set(ds.column_names) == {'text', 'embeddings', 'path'}
+
+
+# ---------------------------------------------------------- warmstart
+def test_encoder_warmstart_registry():
+    from distllm_tpu.registry import registry
+
+    e1 = get_encoder({'name': 'fake', 'embedding_size': 8}, register=True)
+    e2 = get_encoder({'name': 'fake', 'embedding_size': 8}, register=True)
+    assert e1 is e2
+    e3 = get_encoder({'name': 'fake', 'embedding_size': 16}, register=True)
+    assert e3 is not e1
+    registry().clear()

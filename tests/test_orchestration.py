@@ -1,0 +1,227 @@
+"""Orchestration tests: executors, ZMQ fabric, distributed embedding driver."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from distllm_tpu.parallel.launcher import (
+    LocalConfig,
+    PodConfig,
+    WorkstationConfig,
+    get_compute_config,
+)
+
+
+def test_get_compute_config():
+    assert isinstance(get_compute_config({'name': 'local'}), LocalConfig)
+    assert isinstance(
+        get_compute_config({'name': 'workstation', 'max_workers': 2}),
+        WorkstationConfig,
+    )
+    assert isinstance(get_compute_config({'name': 'pod'}), PodConfig)
+    with pytest.raises(ValueError):
+        get_compute_config({'name': 'slurm'})
+
+
+def test_serial_executor(tmp_path):
+    ex = LocalConfig().get_executor(tmp_path / 'run')
+    assert ex.map(lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
+
+
+def _square(x):
+    return x * x
+
+
+def test_process_pool_executor(tmp_path):
+    ex = WorkstationConfig(max_workers=2).get_executor(tmp_path / 'run')
+    assert ex.map(_square, [1, 2, 3, 4]) == [1, 4, 9, 16]
+
+
+def _work(x):
+    if x == 'boom':
+        raise ValueError('boom')
+    return f'done-{x}'
+
+
+def test_zmq_fabric_roundtrip():
+    zmq = pytest.importorskip('zmq')
+    from distllm_tpu.parallel.fabric import (
+        Coordinator,
+        FabricWorker,
+        ZmqPoolExecutor,
+    )
+
+    coordinator = Coordinator(bind='tcp://*:0', retries=0)
+    workers = [FabricWorker(coordinator.endpoint) for _ in range(2)]
+    threads = [threading.Thread(target=w.run, daemon=True) for w in workers]
+    for t in threads:
+        t.start()
+    try:
+        results = ZmqPoolExecutor(coordinator).map(_work, ['a', 'b', 'c', 'd'])
+        assert results == ['done-a', 'done-b', 'done-c', 'done-d']
+    finally:
+        for w in workers:
+            w.stop()
+        coordinator.close()
+
+
+def test_zmq_fabric_propagates_errors():
+    zmq = pytest.importorskip('zmq')
+    from distllm_tpu.parallel.fabric import (
+        Coordinator,
+        FabricWorker,
+        ZmqPoolExecutor,
+    )
+
+    coordinator = Coordinator(bind='tcp://*:0', retries=0)
+    worker = FabricWorker(coordinator.endpoint)
+    thread = threading.Thread(target=worker.run, daemon=True)
+    thread.start()
+    try:
+        with pytest.raises(RuntimeError, match='boom'):
+            ZmqPoolExecutor(coordinator).map(_work, ['a', 'boom'])
+    finally:
+        worker.stop()
+        coordinator.close()
+
+
+def _slow_task(x):
+    import time
+
+    time.sleep(3)
+    return x + 1
+
+
+def test_zmq_fabric_survives_long_tasks():
+    """Task duration >> heartbeat threshold must not livelock (worker
+    heartbeats from a background thread during execution)."""
+    zmq = pytest.importorskip('zmq')
+    from distllm_tpu.parallel.fabric import (
+        Coordinator,
+        FabricWorker,
+        ZmqPoolExecutor,
+    )
+
+    coordinator = Coordinator(bind='tcp://*:0', retries=0, heartbeat_threshold=1.0)
+    worker = FabricWorker(coordinator.endpoint, heartbeat_interval=0.2)
+    thread = threading.Thread(target=worker.run, daemon=True)
+    thread.start()
+    try:
+        results = ZmqPoolExecutor(coordinator).map(_slow_task, [1])
+        assert results == [2]
+    finally:
+        worker.stop()
+        coordinator.close()
+
+
+def test_distributed_embedding_end_to_end(tmp_path):
+    """Full driver: YAML config -> glob -> worker -> shards -> merge."""
+    from datasets import load_from_disk
+
+    from distllm_tpu.distributed_embedding import main
+    from distllm_tpu.embed import get_writer
+
+    input_dir = tmp_path / 'in'
+    input_dir.mkdir()
+    for i in range(3):
+        with open(input_dir / f'part{i}.jsonl', 'w') as fh:
+            for j in range(4):
+                fh.write(
+                    json.dumps(
+                        {'text': f'document {i} chunk {j} words here', 'path': f'doc{i}'}
+                    )
+                    + '\n'
+                )
+
+    config = {
+        'input_dir': str(input_dir),
+        'output_dir': str(tmp_path / 'out'),
+        'glob_patterns': ['*.jsonl'],
+        'dataset_config': {'name': 'jsonl', 'batch_size': 2},
+        'encoder_config': {'name': 'fake', 'embedding_size': 16},
+        'pooler_config': {'name': 'mean'},
+        'embedder_config': {'name': 'full_sequence'},
+        'writer_config': {'name': 'huggingface'},
+        'compute_config': {'name': 'local'},
+    }
+    import yaml
+
+    config_path = tmp_path / 'config.yaml'
+    config_path.write_text(yaml.safe_dump(config))
+    assert main(['--config', str(config_path)]) == 0
+
+    shard_dirs = sorted((tmp_path / 'out' / 'embeddings').iterdir())
+    assert len(shard_dirs) == 3
+    # audit copy exists
+    assert (tmp_path / 'out' / 'config.yaml').exists()
+    # merge step (the reduce)
+    writer = get_writer({'name': 'huggingface'})
+    writer.merge(shard_dirs, tmp_path / 'merged')
+    merged = load_from_disk(str(tmp_path / 'merged'))
+    assert len(merged) == 12
+    assert np.asarray(merged['embeddings']).shape == (12, 16)
+    from distllm_tpu.registry import registry
+
+    registry().clear()
+
+
+def test_cli_embed_and_merge(tmp_path, capsys):
+    from distllm_tpu.cli import main as cli_main
+
+    input_dir = tmp_path / 'in'
+    input_dir.mkdir()
+    with open(input_dir / 'a.jsonl', 'w') as fh:
+        fh.write(json.dumps({'text': 'alpha beta gamma', 'path': 'p'}) + '\n')
+
+    rc = cli_main(
+        [
+            'embed',
+            '--input_dir', str(input_dir),
+            '--output_dir', str(tmp_path / 'out'),
+            '--glob_patterns', '*.jsonl',
+            '--encoder_name', 'fake',
+            '--dataset_name', 'jsonl',
+            '--pooler_name', 'mean',
+            '--writer_name', 'numpy',
+        ]
+    )
+    assert rc == 0
+    shards = list((tmp_path / 'out' / 'embeddings').iterdir())
+    assert len(shards) == 1
+    rc = cli_main(
+        [
+            'merge',
+            '--dataset_dir', str(tmp_path / 'out' / 'embeddings'),
+            '--output_dir', str(tmp_path / 'merged'),
+            '--writer_name', 'numpy',
+        ]
+    )
+    assert rc == 0
+    assert (tmp_path / 'merged' / 'embeddings.npy').exists()
+    from distllm_tpu.registry import registry
+
+    registry().clear()
+
+
+def test_cli_chunk_fasta(tmp_path):
+    from distllm_tpu.cli import main as cli_main
+
+    fasta = tmp_path / 'seqs.fasta'
+    fasta.write_text(''.join(f'>s{i}\nACGT\n' for i in range(10)))
+    rc = cli_main(
+        [
+            'chunk_fasta_file',
+            '--fasta_file', str(fasta),
+            '--output_dir', str(tmp_path / 'chunks'),
+            '--num_chunks', '3',
+        ]
+    )
+    assert rc == 0
+    chunks = sorted((tmp_path / 'chunks').glob('*.fasta'))
+    assert len(chunks) == 3
+    from distllm_tpu.embed.datasets.fasta import read_fasta
+
+    total = sum(len(read_fasta(c)) for c in chunks)
+    assert total == 10
