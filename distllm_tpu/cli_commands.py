@@ -95,6 +95,78 @@ def _merge(parser: argparse.ArgumentParser):
     return run
 
 
+@subcommand('generate', 'Generate responses for input files on this host.')
+def _generate(parser: argparse.ArgumentParser):
+    """Reference parity: ``distllm/cli.py:248-407`` (single-host generate)."""
+    parser.add_argument('--input_dir', required=True)
+    parser.add_argument('--output_dir', required=True)
+    parser.add_argument('--glob_patterns', nargs='+', default=['*'])
+    parser.add_argument('--reader_name', default='jsonl')
+    parser.add_argument('--prompt_name', default='identity')
+    parser.add_argument('--generator_name', default='tpu')
+    parser.add_argument('--pretrained_model_name_or_path', default=None)
+    parser.add_argument('--temperature', type=float, default=0.5)
+    parser.add_argument('--max_tokens', type=int, default=2000)
+    parser.add_argument('--writer_name', default='huggingface')
+
+    def run(args: argparse.Namespace) -> int:
+        from distllm_tpu.distributed_generation import Config, run_generation
+
+        generator_kwargs = {'name': args.generator_name}
+        if args.pretrained_model_name_or_path:
+            generator_kwargs['pretrained_model_name_or_path'] = (
+                args.pretrained_model_name_or_path
+            )
+        if args.generator_name in ('tpu', 'vllm', 'api', 'langchain'):
+            generator_kwargs['temperature'] = args.temperature
+            generator_kwargs['max_tokens'] = args.max_tokens
+        config = Config(
+            input_dir=args.input_dir,
+            output_dir=args.output_dir,
+            glob_patterns=args.glob_patterns,
+            reader_config={'name': args.reader_name},
+            prompt_config={'name': args.prompt_name},
+            generator_config=generator_kwargs,
+            writer_config={'name': args.writer_name},
+        )
+        return run_generation(config)
+
+    return run
+
+
+@subcommand('tokenize', 'Tokenize jsonl files into HF datasets.')
+def _tokenize(parser: argparse.ArgumentParser):
+    """Reference parity: ``distllm/cli.py:410-473``."""
+    parser.add_argument('--input_dir', required=True)
+    parser.add_argument('--output_dir', required=True)
+    parser.add_argument('--glob_patterns', nargs='+', default=['*.jsonl'])
+    parser.add_argument('--tokenizer_name_or_path', required=True)
+    parser.add_argument('--text_field', default='text')
+    parser.add_argument('--max_length', type=int, default=2048)
+    parser.add_argument('--return_labels', action='store_true')
+
+    def run(args: argparse.Namespace) -> int:
+        from distllm_tpu.distributed_tokenization import (
+            Config,
+            run_tokenization,
+        )
+
+        config = Config(
+            input_dir=args.input_dir,
+            output_dir=args.output_dir,
+            glob_patterns=args.glob_patterns,
+            tokenizer_config={
+                'tokenizer_name_or_path': args.tokenizer_name_or_path,
+                'text_field': args.text_field,
+                'max_length': args.max_length,
+                'return_labels': args.return_labels,
+            },
+        )
+        return run_tokenization(config)
+
+    return run
+
+
 @subcommand('chunk_fasta_file', 'Split a FASTA file into N shard files.')
 def _chunk_fasta(parser: argparse.ArgumentParser):
     """Reference parity: ``distllm/cli.py:476-514``."""

@@ -1,0 +1,33 @@
+"""Generation pipeline: readers → prompts → generators → writers + engine.
+
+Mirrors the reference's four strategy families
+(``distllm/generate/__init__.py``) plus the TPU-native paged-KV engine that
+replaces vLLM (SURVEY.md section 2.4 N1). Submodules import lazily so the
+engine can be used without the text-pipeline dependencies.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+_LAZY = {
+    'get_generator': 'distllm_tpu.generate.generators',
+    'GeneratorConfigs': 'distllm_tpu.generate.generators',
+    'get_prompt_template': 'distllm_tpu.generate.prompts',
+    'PromptTemplateConfigs': 'distllm_tpu.generate.prompts',
+    'get_reader': 'distllm_tpu.generate.readers',
+    'ReaderConfigs': 'distllm_tpu.generate.readers',
+    'get_writer': 'distllm_tpu.generate.writers',
+    'WriterConfigs': 'distllm_tpu.generate.writers',
+}
+
+
+def __getattr__(name: str) -> Any:
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(name)
+    return getattr(importlib.import_module(module), name)
+
+
+__all__ = list(_LAZY)

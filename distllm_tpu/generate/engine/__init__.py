@@ -1,0 +1,19 @@
+"""Paged-KV continuous-batching generation engine (vLLM replacement)."""
+
+from distllm_tpu.generate.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    Request,
+    RequestState,
+    SamplingParams,
+)
+from distllm_tpu.generate.engine.kv_cache import PagedKVCache
+
+__all__ = [
+    'EngineConfig',
+    'LLMEngine',
+    'PagedKVCache',
+    'Request',
+    'RequestState',
+    'SamplingParams',
+]

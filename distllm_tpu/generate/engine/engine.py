@@ -1,0 +1,419 @@
+"""Continuous-batching generation engine over the paged KV cache.
+
+The TPU-native replacement for the vLLM offline engine the reference wraps
+(``distllm/generate/generators/vllm_backend.py``; SURVEY.md section 2.4 N1):
+
+- **prefill**: one sequence per call, bucketed prompt lengths (jit cache
+  stays small), K/V scattered into that sequence's blocks;
+- **decode**: ONE jitted step for the whole running batch at fixed shapes
+  (``max_num_seqs`` slots), paged attention over block tables, per-slot
+  sampling params (temperature / top-p / min-p / greedy);
+- **scheduler**: waiting → running admission under block budget, vLLM-style
+  recompute preemption when the pool runs dry mid-decode;
+- requests join and leave the batch between steps — continuous batching.
+
+The KV caches are donated through the jitted step so XLA updates them in
+place in HBM (no per-step cache copies).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from enum import Enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distllm_tpu.generate.engine.kv_cache import PagedKVCache
+from distllm_tpu.models import mistral
+from distllm_tpu.models.tokenizer import bucket_ladder, pick_bucket
+from distllm_tpu.ops.paged_attention import write_prefill_kv
+from distllm_tpu.ops.sampling import sample_tokens
+from distllm_tpu.utils import BaseConfig
+
+
+@dataclass
+class SamplingParams:
+    """vLLM-parity sampling knobs (``vllm_backend.py:48-60``)."""
+
+    temperature: float = 0.5
+    top_p: float = 1.0
+    min_p: float = 0.0
+    max_tokens: int = 2000
+    stop_token_ids: tuple[int, ...] = ()
+
+
+class RequestState(Enum):
+    WAITING = 'waiting'
+    RUNNING = 'running'
+    FINISHED = 'finished'
+
+
+@dataclass
+class Request:
+    request_id: int
+    prompt_ids: list[int]
+    params: SamplingParams
+    state: RequestState = RequestState.WAITING
+    output_ids: list[int] = field(default_factory=list)
+    blocks: list[int] = field(default_factory=list)
+    slot: int | None = None
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_ids) + len(self.output_ids)
+
+
+class EngineConfig(BaseConfig):
+    """Capacity knobs (vLLM analogues: ``max_num_seqs``, ``max_model_len``,
+    ``block_size``, ``gpu_memory_utilization`` → ``num_blocks``)."""
+
+    block_size: int = 16
+    num_blocks: int = 256
+    max_num_seqs: int = 8
+    max_model_len: int = 1024
+    prefill_min_bucket: int = 16
+    prefer_native_allocator: bool = True
+    attn_backend: str = 'xla'  # 'xla' | 'pallas' (TPU decode kernel)
+    seed: int = 0
+
+
+class LLMEngine:
+    """Drives a Mistral-family decoder with paged KV + continuous batching."""
+
+    def __init__(
+        self,
+        model_cfg: mistral.MistralConfig,
+        params: dict,
+        tokenizer,
+        config: EngineConfig | None = None,
+    ) -> None:
+        self.model_cfg = model_cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.config = config or EngineConfig()
+        cfg = self.config
+
+        self.kv = PagedKVCache(
+            num_layers=model_cfg.num_layers,
+            num_blocks=cfg.num_blocks,
+            block_size=cfg.block_size,
+            num_kv_heads=model_cfg.num_kv_heads,
+            head_dim=model_cfg.head_size,
+            dtype=model_cfg.dtype,
+            prefer_native_allocator=cfg.prefer_native_allocator,
+        )
+        self.max_blocks_per_seq = self.kv.blocks_needed(cfg.max_model_len)
+        self.prefill_buckets = bucket_ladder(
+            cfg.max_model_len, cfg.prefill_min_bucket
+        )
+
+        self._waiting: list[Request] = []
+        self._slots: list[Request | None] = [None] * cfg.max_num_seqs
+        self._next_id = itertools.count()
+        self._finished: dict[int, Request] = {}
+        self._key = jax.random.PRNGKey(cfg.seed)
+
+        model = self.model_cfg
+
+        def prefill_fn(params, ids, mask):
+            hidden, k, v = mistral.prefill(params, model, ids, mask)
+            return mistral.logits(params, model, hidden), k, v
+
+        self._prefill = jax.jit(prefill_fn)
+
+        attn_backend = cfg.attn_backend
+        self._decode = jax.jit(
+            lambda params, ids, pos, k, v, bt, ctx: mistral.decode_step(
+                params, model, ids, pos, k, v, bt, ctx,
+                attn_backend=attn_backend,
+            ),
+            donate_argnums=(3, 4),
+        )
+        self._write_prefill = jax.jit(
+            _write_prefill_all_layers, donate_argnums=(0, 1)
+        )
+        self._sample = jax.jit(sample_tokens)
+
+    # ------------------------------------------------------------- requests
+    def add_request(
+        self, prompt_ids: list[int], params: SamplingParams | None = None
+    ) -> int:
+        if not prompt_ids:
+            raise ValueError('empty prompt')
+        # Reserve room for at least one generated token.
+        prompt_ids = prompt_ids[-(self.config.max_model_len - 1) :]
+        needed = self.kv.blocks_needed(len(prompt_ids) + 1)
+        if needed > self.kv.num_blocks - 1:  # block 0 is reserved
+            raise ValueError(
+                f'prompt needs {needed} KV blocks but the pool only has '
+                f'{self.kv.num_blocks - 1}; increase num_blocks'
+            )
+        request = Request(
+            request_id=next(self._next_id),
+            prompt_ids=list(prompt_ids),
+            params=params or SamplingParams(),
+        )
+        self._waiting.append(request)
+        return request.request_id
+
+    @property
+    def has_unfinished(self) -> bool:
+        return bool(self._waiting) or any(
+            r is not None for r in self._slots
+        )
+
+    # ------------------------------------------------------------ scheduling
+    def _free_slot(self) -> int | None:
+        for i, r in enumerate(self._slots):
+            if r is None:
+                return i
+        return None
+
+    def _admit(self) -> list[tuple[int, int]]:
+        """Move waiting requests into free slots while blocks allow.
+
+        Returns the first tokens emitted by prefill as (request_id, token).
+        """
+        emitted: list[tuple[int, int]] = []
+        while self._waiting:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            request = self._waiting[0]
+            # Reserve room for all tokens so far plus one more (preempted
+            # requests re-prefill prompt + generated-so-far).
+            blocks = self.kv.allocate_sequence(request.num_tokens + 1)
+            if blocks is None:
+                if all(r is None for r in self._slots):
+                    raise RuntimeError(
+                        f'request {request.request_id} needs '
+                        f'{self.kv.blocks_needed(request.num_tokens + 1)} KV '
+                        f'blocks but only {self.kv.allocator.num_free} are '
+                        'free with no running requests to wait for; '
+                        'increase num_blocks'
+                    )
+                break
+            self._waiting.pop(0)
+            request.blocks = blocks
+            request.slot = slot
+            request.state = RequestState.RUNNING
+            self._slots[slot] = request
+            emitted.append((request.request_id, self._run_prefill(request)))
+        return emitted
+
+    def _preempt_youngest(self) -> bool:
+        """Free the most recently admitted request back to waiting (recompute
+        preemption, vLLM-style)."""
+        candidates = [r for r in self._slots if r is not None]
+        if len(candidates) <= 1:
+            return False
+        victim = max(candidates, key=lambda r: r.request_id)
+        self.kv.free_sequence(victim.blocks)
+        self._slots[victim.slot] = None
+        victim.slot = None
+        # Recompute preemption: on re-admission the prefill re-runs over
+        # prompt + generated-so-far; output_ids stay intact so the final
+        # result and the max_tokens budget are unaffected.
+        victim.state = RequestState.WAITING
+        self._waiting.insert(0, victim)
+        return True
+
+    # -------------------------------------------------------------- prefill
+    def _run_prefill(self, request: Request) -> int:
+        # Re-prefill covers generated tokens too (recompute preemption path).
+        prompt = request.prompt_ids + request.output_ids
+        bucket = pick_bucket(len(prompt), self.prefill_buckets)
+        ids = np.zeros((1, bucket), np.int32)
+        mask = np.zeros((1, bucket), np.int32)
+        ids[0, : len(prompt)] = prompt
+        mask[0, : len(prompt)] = 1
+
+        logits_all, k_all, v_all = self._prefill(self.params, ids, mask)
+        block_row = self._block_row(request)
+        self.kv.k, self.kv.v = self._write_prefill(
+            self.kv.k,
+            self.kv.v,
+            k_all[:, 0],
+            v_all[:, 0],
+            jnp.asarray(block_row),
+            jnp.int32(len(prompt)),
+        )
+        # First token sampled from the last valid prompt position.
+        last_logits = logits_all[0, len(prompt) - 1][None]
+        token = int(self._sample_batch(last_logits, [request])[0])
+        self._emit_token(request, token)
+        return token
+
+    def _block_row(self, request: Request) -> np.ndarray:
+        row = np.zeros((self.max_blocks_per_seq,), np.int32)
+        row[: len(request.blocks)] = request.blocks
+        return row
+
+    # --------------------------------------------------------------- decode
+    def step(self) -> list[tuple[int, int]]:
+        """One engine iteration. Returns [(request_id, new_token)] emitted."""
+        emitted = self._admit()
+        active = [r for r in self._slots if r is not None]
+        if not active:
+            return emitted
+
+        # Ensure every active sequence has a block for its next token;
+        # preempt on OOM and retry once.
+        for request in list(active):
+            if request.slot is None:
+                continue  # preempted by an earlier iteration of this loop
+            preempted_self = False
+            while not self.kv.extend_sequence(
+                request.blocks, request.num_tokens + 1
+            ):
+                if not self._preempt_youngest():
+                    raise RuntimeError(
+                        'KV cache exhausted with a single running sequence; '
+                        'increase num_blocks or reduce max_model_len'
+                    )
+                if request.slot is None:  # preempted itself
+                    preempted_self = True
+                    break
+            if preempted_self:
+                continue
+        active = [r for r in self._slots if r is not None]
+        if not active:
+            return emitted
+
+        b = self.config.max_num_seqs
+        ids = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        block_tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        context_lens = np.ones((b,), np.int32)
+        for request in active:
+            slot = request.slot
+            last = (
+                request.output_ids[-1]
+                if request.output_ids
+                else request.prompt_ids[-1]
+            )
+            ids[slot] = last
+            positions[slot] = request.num_tokens - 1
+            block_tables[slot] = self._block_row(request)
+            context_lens[slot] = request.num_tokens
+
+        logits, self.kv.k, self.kv.v = self._decode(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(positions),
+            self.kv.k,
+            self.kv.v,
+            jnp.asarray(block_tables),
+            jnp.asarray(context_lens),
+        )
+        tokens = self._sample_batch(
+            logits, [self._slots[i] for i in range(b)]
+        )
+        for request in active:
+            token = int(tokens[request.slot])
+            self._emit_token(request, token)
+            emitted.append((request.request_id, token))
+        return emitted
+
+    def _sample_batch(self, logits: jnp.ndarray, slots) -> np.ndarray:
+        b = logits.shape[0]
+        temperature = np.zeros((b,), np.float32)
+        top_p = np.ones((b,), np.float32)
+        min_p = np.zeros((b,), np.float32)
+        for i, request in enumerate(slots):
+            if request is None:
+                continue
+            temperature[i] = request.params.temperature
+            top_p[i] = request.params.top_p
+            min_p[i] = request.params.min_p
+        self._key, key = jax.random.split(self._key)
+        return np.asarray(
+            self._sample(
+                logits,
+                key,
+                jnp.asarray(temperature),
+                jnp.asarray(top_p),
+                jnp.asarray(min_p),
+            )
+        )
+
+    def _emit_token(self, request: Request, token: int) -> None:
+        # Note: the emitted token is NOT yet written to the KV cache; it is
+        # fed as input on the next decode step, which writes it then.
+        request.output_ids.append(token)
+        eos = getattr(self.tokenizer, 'eos_id', None)
+        stops = set(request.params.stop_token_ids)
+        if eos is not None:
+            stops.add(eos)
+        if (
+            token in stops
+            or len(request.output_ids) >= request.params.max_tokens
+            or request.num_tokens >= self.config.max_model_len
+        ):
+            self._finish(request)
+
+    def _finish(self, request: Request) -> None:
+        request.state = RequestState.FINISHED
+        self.kv.free_sequence(request.blocks)
+        if request.slot is not None:
+            self._slots[request.slot] = None
+            request.slot = None
+        self._finished[request.request_id] = request
+
+    # -------------------------------------------------------------- offline
+    def generate_ids(
+        self,
+        prompts: list[list[int]],
+        params: SamplingParams | None = None,
+    ) -> list[list[int]]:
+        """Offline batch API: token ids in, generated token ids out."""
+        ids = [self.add_request(p, params) for p in prompts]
+        while self.has_unfinished:
+            self.step()
+        outs = []
+        for rid in ids:
+            request = self._finished.pop(rid)
+            out = request.output_ids
+            # Strip the stop token if present.
+            eos = getattr(self.tokenizer, 'eos_id', None)
+            stops = set(request.params.stop_token_ids)
+            if eos is not None:
+                stops.add(eos)
+            if out and out[-1] in stops:
+                out = out[:-1]
+            outs.append(out)
+        return outs
+
+    def generate(
+        self, prompts: list[str], params: SamplingParams | None = None
+    ) -> list[str]:
+        """Offline text API (vLLM ``llm.generate`` parity)."""
+        batches = self.tokenizer(prompts)
+        prompt_ids = [
+            [int(t) for t, m in zip(row_ids, row_mask) if m]
+            for row_ids, row_mask in zip(
+                batches.input_ids, batches.attention_mask
+            )
+        ]
+        outputs = self.generate_ids(prompt_ids, params)
+        return [self.tokenizer.decode(out) for out in outputs]
+
+    def shutdown(self) -> None:
+        self.params = None
+        self.kv = None
+
+
+def _write_prefill_all_layers(k_cache, v_cache, k_seq, v_seq, block_row, length):
+    """Scatter ``[L, S, N_kv, Hd]`` prefill K/V into the paged cache."""
+    seq_len = k_seq.shape[1]
+    block_size = k_cache.shape[2]
+    positions = jnp.arange(seq_len)
+    valid = positions < length
+    block_ids = jnp.where(valid, block_row[positions // block_size], 0)
+    offsets = jnp.where(valid, positions % block_size, 0)
+    k_cache = k_cache.at[:, block_ids, offsets].set(k_seq.astype(k_cache.dtype))
+    v_cache = v_cache.at[:, block_ids, offsets].set(v_seq.astype(v_cache.dtype))
+    return k_cache, v_cache

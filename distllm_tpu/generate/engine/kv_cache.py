@@ -1,0 +1,177 @@
+"""Paged KV cache: HBM block pool + block allocator (native C++ or Python).
+
+The TPU replacement for vLLM's paged KV memory management (SURVEY.md
+section 2.4 N1): K/V live as ``[L, num_blocks, block_size, N_kv, Hd]`` device
+arrays; sequences own lists of block ids. Block 0 is the reserved TRASH
+block — padded scatter writes land there (see ``ops/paged_attention``).
+
+The allocator is the C++ free-list/refcount implementation in
+``distllm_tpu/native/block_allocator.cpp`` (ctypes), with a drop-in Python
+fallback when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BlockAllocator(Protocol):
+    def alloc(self) -> int: ...
+
+    def free(self, block_id: int) -> None: ...
+
+    def incref(self, block_id: int) -> None: ...
+
+    @property
+    def num_free(self) -> int: ...
+
+
+class PyBlockAllocator:
+    """Pure-Python free-list allocator (fallback; same semantics as C++)."""
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks < 2:
+            raise ValueError('need >= 2 blocks (block 0 is reserved)')
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._refcount = [0] * num_blocks
+        self._refcount[0] = 1  # trash block, never free
+
+    def alloc(self) -> int:
+        if not self._free:
+            return -1
+        block_id = self._free.pop()
+        self._refcount[block_id] = 1
+        return block_id
+
+    def incref(self, block_id: int) -> None:
+        assert self._refcount[block_id] > 0
+        self._refcount[block_id] += 1
+
+    def free(self, block_id: int) -> None:
+        assert self._refcount[block_id] > 0, f'double free of block {block_id}'
+        self._refcount[block_id] -= 1
+        if self._refcount[block_id] == 0:
+            self._free.append(block_id)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+
+class NativeBlockAllocator:
+    """ctypes wrapper over the C++ allocator."""
+
+    def __init__(self, num_blocks: int) -> None:
+        from distllm_tpu.native import build_library
+
+        so_path = build_library('block_allocator.cpp')
+        if so_path is None:
+            raise RuntimeError('native allocator unavailable')
+        lib = ctypes.CDLL(str(so_path))
+        lib.ba_create.restype = ctypes.c_void_p
+        lib.ba_create.argtypes = [ctypes.c_int32]
+        for fn in ('ba_alloc', 'ba_incref', 'ba_free', 'ba_num_free'):
+            getattr(lib, fn).restype = ctypes.c_int32
+        lib.ba_alloc.argtypes = [ctypes.c_void_p]
+        lib.ba_num_free.argtypes = [ctypes.c_void_p]
+        lib.ba_incref.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.ba_free.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.ba_destroy.argtypes = [ctypes.c_void_p]
+        handle = lib.ba_create(num_blocks)
+        if not handle:
+            raise RuntimeError(f'ba_create({num_blocks}) failed')
+        self._lib = lib
+        self._handle = handle
+
+    def alloc(self) -> int:
+        return int(self._lib.ba_alloc(self._handle))
+
+    def incref(self, block_id: int) -> None:
+        if self._lib.ba_incref(self._handle, block_id) < 0:
+            raise ValueError(f'incref of unallocated block {block_id}')
+
+    def free(self, block_id: int) -> None:
+        if self._lib.ba_free(self._handle, block_id) < 0:
+            raise ValueError(f'double free of block {block_id}')
+
+    @property
+    def num_free(self) -> int:
+        return int(self._lib.ba_num_free(self._handle))
+
+    def __del__(self) -> None:  # pragma: no cover - interpreter teardown
+        lib = getattr(self, '_lib', None)
+        handle = getattr(self, '_handle', None)
+        if lib is not None and handle:
+            lib.ba_destroy(handle)
+            self._handle = None
+
+
+def make_allocator(num_blocks: int, prefer_native: bool = True) -> BlockAllocator:
+    if prefer_native:
+        try:
+            return NativeBlockAllocator(num_blocks)
+        except (RuntimeError, OSError):
+            pass
+    return PyBlockAllocator(num_blocks)
+
+
+class PagedKVCache:
+    """Device-resident paged K/V arrays plus per-sequence block bookkeeping."""
+
+    def __init__(
+        self,
+        num_layers: int,
+        num_blocks: int,
+        block_size: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype: str = 'bfloat16',
+        prefer_native_allocator: bool = True,
+    ) -> None:
+        shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+        self.v = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.allocator = make_allocator(num_blocks, prefer_native_allocator)
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return (num_tokens + self.block_size - 1) // self.block_size
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.allocator.num_free >= self.blocks_needed(num_tokens)
+
+    def allocate_sequence(self, num_tokens: int) -> list[int] | None:
+        """Allocate blocks for a sequence; None if insufficient."""
+        needed = self.blocks_needed(num_tokens)
+        if self.allocator.num_free < needed:
+            return None
+        blocks = []
+        for _ in range(needed):
+            block_id = self.allocator.alloc()
+            assert block_id > 0
+            blocks.append(block_id)
+        return blocks
+
+    def extend_sequence(self, blocks: list[int], num_tokens: int) -> bool:
+        """Grow a sequence's block list to cover ``num_tokens``; False = OOM."""
+        while len(blocks) < self.blocks_needed(num_tokens):
+            block_id = self.allocator.alloc()
+            if block_id < 0:
+                return False
+            blocks.append(block_id)
+        return True
+
+    def free_sequence(self, blocks: list[int]) -> None:
+        for block_id in blocks:
+            self.allocator.free(block_id)
+        blocks.clear()
+
+    @property
+    def hbm_bytes(self) -> int:
+        return int(self.k.nbytes + self.v.nbytes)

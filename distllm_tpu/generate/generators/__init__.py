@@ -1,0 +1,62 @@
+"""Generator strategy factory with optional warmstart registration.
+
+Reference parity: ``generate/generators/__init__.py:55-89``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.generate.generators.api_backend import (
+    ApiGenerator,
+    ApiGeneratorConfig,
+)
+from distllm_tpu.generate.generators.base import LLMGenerator
+from distllm_tpu.generate.generators.huggingface_backend import (
+    HuggingFaceGenerator,
+    HuggingFaceGeneratorConfig,
+)
+from distllm_tpu.generate.generators.tpu_backend import (
+    FakeGenerator,
+    FakeGeneratorConfig,
+    TpuGenerator,
+    TpuGeneratorConfig,
+)
+from distllm_tpu.registry import registry
+
+GeneratorConfigs = Union[
+    TpuGeneratorConfig,
+    HuggingFaceGeneratorConfig,
+    ApiGeneratorConfig,
+    FakeGeneratorConfig,
+]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'tpu': (TpuGeneratorConfig, TpuGenerator),
+    'vllm': (TpuGeneratorConfig, TpuGenerator),  # reference-config alias
+    'huggingface': (HuggingFaceGeneratorConfig, HuggingFaceGenerator),
+    'api': (ApiGeneratorConfig, ApiGenerator),
+    'langchain': (ApiGeneratorConfig, ApiGenerator),  # reference-config alias
+    'fake': (FakeGeneratorConfig, FakeGenerator),
+}
+
+
+def _build_generator(**kwargs: Any) -> LLMGenerator:
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown generator name: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+def get_generator(kwargs: dict[str, Any], register: bool = False) -> LLMGenerator:
+    """Build a generator; ``register=True`` reuses the cached warm instance."""
+    if register:
+        return registry().get(_build_generator, slot='generator', **kwargs)
+    return _build_generator(**kwargs)
+
+
+__all__ = ['LLMGenerator', 'GeneratorConfigs', 'get_generator', 'STRATEGIES']

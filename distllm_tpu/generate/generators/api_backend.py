@@ -1,0 +1,66 @@
+"""API generator: OpenAI-compatible chat-completions over HTTP.
+
+Reference parity: ``generate/generators/langchain_backend.py`` — the
+reference drives gpt/gemini/claude through LangChain's LLMChain; langchain
+is unavailable here, so this talks the OpenAI-compatible wire protocol
+directly (``requests``), which also covers our own chat server and any
+vLLM-style endpoint. Registered under both ``api`` and ``langchain``.
+API keys come from the environment (reference uses dotenv).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Literal
+
+from pydantic import Field
+
+from distllm_tpu.utils import BaseConfig, expo_backoff_retry
+
+
+class ApiGeneratorConfig(BaseConfig):
+    name: Literal['api', 'langchain'] = 'api'
+    openai_api_base: str = 'https://api.openai.com/v1'
+    model: str = 'gpt-3.5-turbo'
+    api_key_env: str = Field(
+        default='OPENAI_API_KEY', description='Env var holding the API key.'
+    )
+    temperature: float = 0.0
+    max_tokens: int = 512
+    timeout: float = 120.0
+    max_tries: int = 5
+
+
+class ApiGenerator:
+    def __init__(self, config: ApiGeneratorConfig) -> None:
+        self.config = config
+
+    def _chat(self, prompt: str) -> str:
+        import requests
+
+        headers = {'Content-Type': 'application/json'}
+        api_key = os.environ.get(self.config.api_key_env, '')
+        if api_key:
+            headers['Authorization'] = f'Bearer {api_key}'
+
+        def call() -> str:
+            response = requests.post(
+                f'{self.config.openai_api_base.rstrip("/")}/chat/completions',
+                json={
+                    'model': self.config.model,
+                    'messages': [{'role': 'user', 'content': prompt}],
+                    'temperature': self.config.temperature,
+                    'max_tokens': self.config.max_tokens,
+                },
+                headers=headers,
+                timeout=self.config.timeout,
+            )
+            response.raise_for_status()
+            return response.json()['choices'][0]['message']['content']
+
+        return expo_backoff_retry(call, max_tries=self.config.max_tries)
+
+    def generate(self, prompts: str | list[str]) -> list[str]:
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        return [self._chat(p) for p in prompts]

@@ -1,0 +1,12 @@
+"""Generator protocol (reference: ``generate/generators/base.py:10-24``)."""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class LLMGenerator(Protocol):
+    config: object
+
+    def generate(self, prompts: str | list[str]) -> list[str]: ...

@@ -1,0 +1,79 @@
+"""HuggingFace torch generator (CPU-capable fallback backend).
+
+Reference parity: ``generate/generators/huggingface_backend.py`` —
+``AutoModelForCausalLM.generate`` with top-p/beams/do_sample and manual
+batching via ``batch_data``. On this framework it serves as the
+correctness/compat backend (e.g. architectures the JAX engine doesn't cover
+yet); quantization flags are accepted but mapped to torch dtypes (no
+bitsandbytes on TPU hosts).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from pydantic import Field
+
+from distllm_tpu.utils import BaseConfig, batch_data
+
+
+class HuggingFaceGeneratorConfig(BaseConfig):
+    name: Literal['huggingface'] = 'huggingface'
+    pretrained_model_name_or_path: str
+    half_precision: bool = False
+    batch_size: int = 4
+    top_p: float = 0.95
+    num_beams: int = 1
+    do_sample: bool = True
+    max_new_tokens: int = 256
+    trust_remote_code: bool = False
+
+
+class HuggingFaceGenerator:
+    def __init__(self, config: HuggingFaceGeneratorConfig) -> None:
+        import torch
+        from transformers import AutoModelForCausalLM, AutoTokenizer
+
+        self.config = config
+        self._torch = torch
+        self.tokenizer = AutoTokenizer.from_pretrained(
+            config.pretrained_model_name_or_path,
+            trust_remote_code=config.trust_remote_code,
+        )
+        if self.tokenizer.pad_token is None:
+            self.tokenizer.pad_token = self.tokenizer.eos_token
+        dtype = torch.float16 if config.half_precision else torch.float32
+        self.model = AutoModelForCausalLM.from_pretrained(
+            config.pretrained_model_name_or_path,
+            torch_dtype=dtype,
+            trust_remote_code=config.trust_remote_code,
+        ).eval()
+
+    def generate(self, prompts: str | list[str]) -> list[str]:
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        torch = self._torch
+        responses: list[str] = []
+        for batch in batch_data(prompts, self.config.batch_size):
+            inputs = self.tokenizer(
+                batch, return_tensors='pt', padding=True, truncation=True
+            )
+            with torch.no_grad():
+                outputs = self.model.generate(
+                    **inputs,
+                    max_new_tokens=self.config.max_new_tokens,
+                    top_p=self.config.top_p,
+                    num_beams=self.config.num_beams,
+                    do_sample=self.config.do_sample,
+                    pad_token_id=self.tokenizer.pad_token_id,
+                )
+            prompt_len = inputs['input_ids'].shape[1]
+            responses.extend(
+                self.tokenizer.batch_decode(
+                    outputs[:, prompt_len:], skip_special_tokens=True
+                )
+            )
+        return responses
+
+    def shutdown(self) -> None:
+        self.model = None

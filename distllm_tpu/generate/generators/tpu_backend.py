@@ -1,0 +1,129 @@
+"""TPU generator: the in-process paged-KV engine behind the LLMGenerator API.
+
+Reference parity: ``distllm/generate/generators/vllm_backend.py`` — same
+config surface (model path, temperature, ``top_p`` XOR ``min_p``,
+``max_tokens``, ``tensor_parallel_size``) but the backend is our own
+JAX/Pallas engine instead of vLLM. Registered under both ``tpu`` and
+``vllm`` names so reference YAML configs keep working.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from pydantic import Field, model_validator
+
+from distllm_tpu.generate.engine import EngineConfig, LLMEngine, SamplingParams
+from distllm_tpu.utils import BaseConfig
+
+
+class TpuGeneratorConfig(BaseConfig):
+    name: Literal['tpu', 'vllm'] = 'tpu'
+    pretrained_model_name_or_path: str = Field(
+        description='Local path to an HF-format decoder checkpoint.'
+    )
+    tokenizer_name: str | None = None
+    trust_remote_code: bool = False
+    temperature: float = 0.5
+    min_p: float = 0.1
+    top_p: float = 0.0
+    max_tokens: int = 2000
+    tensor_parallel_size: int = Field(
+        default=1, description='TP degree over the mesh model axis.'
+    )
+    # Engine capacity knobs (vLLM analogues).
+    block_size: int = 16
+    num_blocks: int = 2048
+    max_num_seqs: int = 16
+    max_model_len: int = 4096
+
+    @model_validator(mode='after')
+    def _xor_top_p_min_p(self) -> 'TpuGeneratorConfig':
+        # Reference behavior (vllm_backend.py:48-60): top_p and min_p are
+        # mutually exclusive; min_p wins by default.
+        if self.top_p and self.min_p:
+            raise ValueError('Only one of top_p or min_p can be set')
+        return self
+
+
+class TpuGenerator:
+    def __init__(self, config: TpuGeneratorConfig) -> None:
+        import jax
+
+        from distllm_tpu.models import mistral
+        from distllm_tpu.models.loader import read_checkpoint, read_hf_config
+        from distllm_tpu.models.tokenizer import HFTokenizer
+        from distllm_tpu.parallel.mesh import MeshSpec, make_mesh
+        from distllm_tpu.parallel.sharding import shard_pytree
+
+        self.config = config
+        hf_cfg = read_hf_config(config.pretrained_model_name_or_path)
+        model_cfg = mistral.MistralConfig.from_hf_config(hf_cfg)
+        params = mistral.params_from_hf(
+            read_checkpoint(config.pretrained_model_name_or_path), model_cfg
+        )
+        if config.tensor_parallel_size > 1:
+            mesh = make_mesh(
+                MeshSpec(data=1, model=config.tensor_parallel_size),
+                devices=jax.devices()[: config.tensor_parallel_size],
+            )
+            params = shard_pytree(
+                params, mistral.param_specs(model_cfg, params), mesh
+            )
+        tokenizer = HFTokenizer(
+            config.tokenizer_name or config.pretrained_model_name_or_path,
+            trust_remote_code=config.trust_remote_code,
+        )
+        if getattr(tokenizer._tok, 'eos_token_id', None) is not None:
+            tokenizer.eos_id = int(tokenizer._tok.eos_token_id)
+        self.engine = LLMEngine(
+            model_cfg,
+            params,
+            tokenizer,
+            EngineConfig(
+                block_size=config.block_size,
+                num_blocks=config.num_blocks,
+                max_num_seqs=config.max_num_seqs,
+                max_model_len=config.max_model_len,
+            ),
+        )
+
+    def _sampling_params(self) -> SamplingParams:
+        return SamplingParams(
+            temperature=self.config.temperature,
+            top_p=self.config.top_p or 1.0,
+            min_p=self.config.min_p,
+            max_tokens=self.config.max_tokens,
+        )
+
+    def generate(self, prompts: str | list[str]) -> list[str]:
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        return self.engine.generate(prompts, self._sampling_params())
+
+    def shutdown(self) -> None:
+        self.engine.shutdown()
+
+
+class FakeGeneratorConfig(BaseConfig):
+    """Deterministic local test backend (no reference equivalent — the
+    reference relies on downloading small real models; SURVEY.md section 4)."""
+
+    name: Literal['fake'] = 'fake'
+    response_template: str = 'response to: {prompt}'
+    max_prompt_chars: int = 48
+
+
+class FakeGenerator:
+    def __init__(self, config: FakeGeneratorConfig) -> None:
+        self.config = config
+
+    def generate(self, prompts: str | list[str]) -> list[str]:
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        return [
+            self.config.response_template.format(
+                prompt=p[: self.config.max_prompt_chars]
+            )
+            for p in prompts
+        ]

@@ -1,0 +1,65 @@
+"""Prompt template factory (reference: ``generate/prompts/__init__.py:39-54``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.generate.prompts.amp_question import (
+    AMPQuestionPromptConfig,
+    AMPQuestionPromptTemplate,
+)
+from distllm_tpu.generate.prompts.base import PromptTemplate
+from distllm_tpu.generate.prompts.identity import (
+    IdentityPromptTemplate,
+    IdentityPromptTemplateConfig,
+)
+from distllm_tpu.generate.prompts.keyword_selection import (
+    KeywordSelectionPromptTemplate,
+    KeywordSelectionPromptTemplateConfig,
+)
+from distllm_tpu.generate.prompts.question_answer import (
+    QuestionAnswerPromptTemplate,
+    QuestionAnswerPromptTemplateConfig,
+)
+from distllm_tpu.generate.prompts.question_chunk import (
+    QuestionChunkPromptTemplate,
+    QuestionChunkPromptTemplateConfig,
+)
+
+PromptTemplateConfigs = Union[
+    IdentityPromptTemplateConfig,
+    QuestionChunkPromptTemplateConfig,
+    QuestionAnswerPromptTemplateConfig,
+    KeywordSelectionPromptTemplateConfig,
+    AMPQuestionPromptConfig,
+]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'identity': (IdentityPromptTemplateConfig, IdentityPromptTemplate),
+    'question_chunk': (QuestionChunkPromptTemplateConfig, QuestionChunkPromptTemplate),
+    'question_answer': (QuestionAnswerPromptTemplateConfig, QuestionAnswerPromptTemplate),
+    'keyword_selection': (
+        KeywordSelectionPromptTemplateConfig,
+        KeywordSelectionPromptTemplate,
+    ),
+    'amp_question': (AMPQuestionPromptConfig, AMPQuestionPromptTemplate),
+}
+
+
+def get_prompt_template(kwargs: dict[str, Any]) -> PromptTemplate:
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown prompt template: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+__all__ = [
+    'PromptTemplate',
+    'PromptTemplateConfigs',
+    'get_prompt_template',
+    'STRATEGIES',
+]

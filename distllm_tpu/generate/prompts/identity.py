@@ -1,0 +1,29 @@
+"""Identity template: pass texts through unchanged (reference:
+``generate/prompts/identity.py:16-63``)."""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from distllm_tpu.generate.prompts.base import ensure_list
+from distllm_tpu.utils import BaseConfig
+
+
+class IdentityPromptTemplateConfig(BaseConfig):
+    name: Literal['identity'] = 'identity'
+
+
+class IdentityPromptTemplate:
+    def __init__(self, config: IdentityPromptTemplateConfig) -> None:
+        self.config = config
+
+    def preprocess(
+        self,
+        text: str | list[str],
+        contexts: list[list[str]] | None = None,
+        scores: list[list[float]] | None = None,
+    ) -> list[str]:
+        return ensure_list(text)
+
+    def postprocess(self, responses: list[str]) -> list[str]:
+        return responses

@@ -1,0 +1,57 @@
+"""Keyword-selection template (reference: ``generate/prompts/keyword_selection.py``).
+
+Given a fixed keyword list (inline or newline-separated file) and a document,
+ask the model for the 3 most relevant keywords.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Literal, Union
+
+from distllm_tpu.generate.prompts.base import ensure_list
+from distllm_tpu.utils import BaseConfig
+
+
+class KeywordSelectionPromptTemplateConfig(BaseConfig):
+    name: Literal['keyword_selection'] = 'keyword_selection'
+    keywords: Union[Path, list[str]]
+
+
+class KeywordSelectionPromptTemplate:
+    template = (
+        'You are an expert scientist in radiation-based medicine and biology '
+        'and all adjacent scientific domains.\n'
+        'Given a list of domain keywords and a paragraph, select the 3 '
+        'keywords most relevant to the paragraph, ordered by relevance '
+        'ascending.\n'
+        'The document:\n\n{document}\n\n----\n\n'
+        'List of keywords: {keywords_list}\n\n'
+        'Write an answer based on the context.\n'
+        'If every keyword is equally irrelevant, return the str '
+        '`None of the above` 3 times.\n'
+        'Answer: '
+    )
+
+    def __init__(self, config: KeywordSelectionPromptTemplateConfig) -> None:
+        self.config = config
+        if isinstance(config.keywords, Path):
+            self.keywords_list = config.keywords.read_text().splitlines()
+        else:
+            self.keywords_list = list(config.keywords)
+
+    def preprocess(
+        self,
+        text: str | list[str],
+        contexts: list[list[str]] | None = None,
+        scores: list[list[float]] | None = None,
+    ) -> list[str]:
+        return [
+            self.template.format(
+                keywords_list=self.keywords_list, document=document
+            )
+            for document in ensure_list(text)
+        ]
+
+    def postprocess(self, responses: list[str]) -> list[str]:
+        return responses

@@ -1,0 +1,35 @@
+"""Reader strategy factory (reference: ``distllm/generate/readers/__init__.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.generate.readers.amp_json import AMPJsonReader, AMPJsonReaderConfig
+from distllm_tpu.generate.readers.base import Reader
+from distllm_tpu.generate.readers.huggingface import (
+    HuggingFaceReader,
+    HuggingFaceReaderConfig,
+)
+from distllm_tpu.generate.readers.jsonl import JsonlReader, JsonlReaderConfig
+
+ReaderConfigs = Union[JsonlReaderConfig, HuggingFaceReaderConfig, AMPJsonReaderConfig]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'jsonl': (JsonlReaderConfig, JsonlReader),
+    'huggingface': (HuggingFaceReaderConfig, HuggingFaceReader),
+    'amp_json': (AMPJsonReaderConfig, AMPJsonReader),
+}
+
+
+def get_reader(kwargs: dict[str, Any]) -> Reader:
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown reader name: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+__all__ = ['Reader', 'ReaderConfigs', 'get_reader', 'STRATEGIES']

@@ -1,0 +1,35 @@
+"""AMP protein JSON reader (reference: ``generate/readers/amp_json.py:19-53``).
+
+The nested JSON maps group-name → list of entries; each entry is serialized
+back to JSON and used as BOTH text and path, so the writer can merge model
+outputs back into the original entries.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Literal
+
+from distllm_tpu.utils import BaseConfig
+
+
+class AMPJsonReaderConfig(BaseConfig):
+    name: Literal['amp_json'] = 'amp_json'
+
+
+class AMPJsonReader:
+    def __init__(self, config: AMPJsonReaderConfig) -> None:
+        self.config = config
+
+    def read(self, input_path: str | Path) -> tuple[list[str], list[str]]:
+        with open(input_path) as fh:
+            data = json.load(fh)
+        texts: list[str] = []
+        paths: list[str] = []
+        for entries in data.values():
+            for entry in entries:
+                serialized = json.dumps(entry)
+                texts.append(serialized)
+                paths.append(serialized)
+        return texts, paths

@@ -1,0 +1,18 @@
+"""Reader protocol: ``read(input_path) -> (texts, paths)``.
+
+Reference parity: ``distllm/generate/readers/base.py:10-30`` — ``paths``
+carries per-item provenance (or full metadata JSON for AMP) through the
+generation pipeline to the writer.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Reader(Protocol):
+    config: object
+
+    def read(self, input_path: str | Path) -> tuple[list[str], list[str]]: ...

@@ -1,0 +1,33 @@
+"""Jsonl reader (reference: ``distllm/generate/readers/jsonl.py:22-53``)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Literal
+
+from distllm_tpu.utils import BaseConfig
+
+
+class JsonlReaderConfig(BaseConfig):
+    name: Literal['jsonl'] = 'jsonl'
+    text_field: str = 'text'
+    path_field: str = 'path'
+
+
+class JsonlReader:
+    def __init__(self, config: JsonlReaderConfig) -> None:
+        self.config = config
+
+    def read(self, input_path: str | Path) -> tuple[list[str], list[str]]:
+        texts: list[str] = []
+        paths: list[str] = []
+        with open(input_path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                entry = json.loads(line)
+                texts.append(entry[self.config.text_field])
+                paths.append(str(entry.get(self.config.path_field, input_path)))
+        return texts, paths

@@ -1,0 +1,36 @@
+"""Generate-writer factory (reference: ``generate/writers/__init__.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from distllm_tpu.generate.writers.amp_json import (
+    AMPJsonlWriter,
+    AMPJsonlWriterConfig,
+)
+from distllm_tpu.generate.writers.base import Writer
+from distllm_tpu.generate.writers.huggingface import (
+    HuggingFaceWriter,
+    HuggingFaceWriterConfig,
+)
+
+WriterConfigs = Union[HuggingFaceWriterConfig, AMPJsonlWriterConfig]
+
+STRATEGIES: dict[str, tuple[type, type]] = {
+    'huggingface': (HuggingFaceWriterConfig, HuggingFaceWriter),
+    'amp_jsonl': (AMPJsonlWriterConfig, AMPJsonlWriter),
+}
+
+
+def get_writer(kwargs: dict[str, Any]) -> Writer:
+    name = kwargs.get('name', '')
+    entry = STRATEGIES.get(name)
+    if entry is None:
+        raise ValueError(
+            f'Unknown writer name: {name!r}. Available: {sorted(STRATEGIES)}'
+        )
+    config_cls, cls = entry
+    return cls(config_cls(**kwargs))
+
+
+__all__ = ['Writer', 'WriterConfigs', 'get_writer', 'STRATEGIES']

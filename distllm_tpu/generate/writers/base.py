@@ -1,0 +1,23 @@
+"""Generate-writer protocol (reference: ``generate/writers/base.py:11-50``)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Writer(Protocol):
+    config: object
+
+    def write(
+        self,
+        output_dir: str | Path,
+        paths: list[str],
+        text: list[str],
+        responses: list[str],
+    ) -> None: ...
+
+    def merge(
+        self, dataset_dirs: list[str | Path], output_dir: str | Path
+    ) -> None: ...

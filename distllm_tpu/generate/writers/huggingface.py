@@ -1,0 +1,52 @@
+"""HF-dataset generate writer: ``{path, text, response}`` rows.
+
+Reference parity: ``generate/writers/huggingface.py:32-89`` — merge loads
+every shard and SKIPS missing/corrupt ones (partial re-runs rely on this).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Literal
+
+from distllm_tpu.utils import BaseConfig
+
+
+class HuggingFaceWriterConfig(BaseConfig):
+    name: Literal['huggingface'] = 'huggingface'
+    num_proc: int | None = None
+
+
+class HuggingFaceWriter:
+    def __init__(self, config: HuggingFaceWriterConfig) -> None:
+        self.config = config
+
+    def write(
+        self,
+        output_dir: str | Path,
+        paths: list[str],
+        text: list[str],
+        responses: list[str],
+    ) -> None:
+        from datasets import Dataset
+
+        Dataset.from_dict(
+            {'path': paths, 'text': text, 'response': responses}
+        ).save_to_disk(str(output_dir))
+
+    def merge(
+        self, dataset_dirs: list[str | Path], output_dir: str | Path
+    ) -> None:
+        from datasets import concatenate_datasets, load_from_disk
+
+        shards = []
+        for path in dataset_dirs:
+            try:
+                shards.append(load_from_disk(str(path)))
+            except Exception as exc:  # noqa: BLE001 - skip bad shards
+                print(f'[writer] skipping shard {path}: {exc}')
+        if not shards:
+            raise ValueError(f'no readable shards among {len(dataset_dirs)} dirs')
+        concatenate_datasets(shards).save_to_disk(
+            str(output_dir), num_proc=self.config.num_proc
+        )

@@ -173,6 +173,88 @@ def _forward(params, cfg, input_ids, attention_mask, *, collect_kv):
     return hidden, None, None
 
 
+def decode_step(
+    params: dict,
+    cfg: MistralConfig,
+    input_ids: jnp.ndarray,  # [B] one new token per sequence
+    positions: jnp.ndarray,  # [B] 0-based index of that token
+    k_cache: jnp.ndarray,  # [L, num_blocks, block_size, N_kv, Hd]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    context_lens: jnp.ndarray,  # [B] valid tokens incl. the new one
+    attn_backend: str = 'xla',
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Single-token decode over the paged KV cache.
+
+    Returns ``(logits [B, V] fp32, k_cache, v_cache)`` with the new token's
+    K/V written into the paged blocks. Inactive batch slots should point
+    their block table rows at the reserved trash block 0.
+
+    ``attn_backend`` selects the XLA gather baseline or the Pallas kernel;
+    sliding-window checkpoints (``cfg.sliding_window``) force the XLA path,
+    which applies the same window mask as prefill.
+    """
+    from distllm_tpu.ops.paged_attention import (
+        paged_attention_pallas,
+        paged_attention_xla,
+        write_token_kv,
+    )
+
+    if cfg.sliding_window is not None or attn_backend == 'xla':
+
+        def attend(q, k_cache_l, v_cache_l):
+            return paged_attention_xla(
+                q, k_cache_l, v_cache_l, block_tables, context_lens,
+                sliding_window=cfg.sliding_window,
+            )
+    else:
+
+        def attend(q, k_cache_l, v_cache_l):
+            return paged_attention_pallas(
+                q, k_cache_l, v_cache_l, block_tables, context_lens
+            )
+
+    dtype = jnp.dtype(cfg.dtype)
+    cos, sin = _rope_tables(cfg, cfg.max_position_embeddings)
+    x = jnp.asarray(params['embed'])[input_ids].astype(dtype)  # [B, H]
+
+    def layer(x, xs):
+        lp, k_cache_l, v_cache_l = xs
+        normed = common.rms_norm(x, lp['attn_ln']['scale'], cfg.rms_norm_eps)
+        q = common.dense(normed, lp['q']['kernel']).reshape(
+            -1, cfg.num_heads, cfg.head_size
+        )
+        k = common.dense(normed, lp['k']['kernel']).reshape(
+            -1, cfg.num_kv_heads, cfg.head_size
+        )
+        v = common.dense(normed, lp['v']['kernel']).reshape(
+            -1, cfg.num_kv_heads, cfg.head_size
+        )
+        # RoPE at each sequence's own position ([B, 1, N, Hd] view).
+        q = common.apply_rope(q[:, None], cos, sin, positions[:, None])[:, 0]
+        k = common.apply_rope(k[:, None], cos, sin, positions[:, None])[:, 0]
+        k_cache_l, v_cache_l = write_token_kv(
+            k_cache_l, v_cache_l, k, v, block_tables, positions
+        )
+        attn = attend(q, k_cache_l, v_cache_l)
+        x = x + common.dense(
+            attn.reshape(-1, cfg.num_heads * cfg.head_size), lp['o']['kernel']
+        )
+        normed2 = common.rms_norm(x, lp['mlp_ln']['scale'], cfg.rms_norm_eps)
+        mlp = common.dense(
+            common.silu(common.dense(normed2, lp['gate']['kernel']))
+            * common.dense(normed2, lp['up']['kernel']),
+            lp['down']['kernel'],
+        )
+        return x + mlp, (k_cache_l, v_cache_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer, x, (params['layers'], k_cache, v_cache)
+    )
+    hidden = common.rms_norm(x, params['final_ln']['scale'], cfg.rms_norm_eps)
+    return logits(params, cfg, hidden), k_cache, v_cache
+
+
 def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray:
     """LM head: ``[..., H]`` hidden → fp32 ``[..., V]`` logits."""
     if cfg.tie_word_embeddings or 'lm_head' not in params:

@@ -1,0 +1,207 @@
+"""Paged-KV decode attention — the core kernel of the generation engine.
+
+The reference delegates this to vLLM's CUDA paged-attention
+(``generate/generators/vllm_backend.py``; SURVEY.md section 2.4 N1). Here the
+KV cache lives in HBM as fixed-size blocks::
+
+    k_cache, v_cache : [num_blocks, block_size, num_kv_heads, head_dim]
+
+and each decoding sequence owns a row of ``block_tables`` (block ids, padded)
+plus a ``context_lens`` entry (valid tokens). Two implementations share a
+signature:
+
+- :func:`paged_attention_xla` — gather + masked softmax; XLA fuses this well
+  and it is the portable baseline (also runs on CPU for tests).
+- :func:`paged_attention_pallas` — Pallas TPU kernel: grid over sequences,
+  block tables scalar-prefetched so each program DMAs exactly its own KV
+  blocks VMEM-side, online-softmax accumulation in fp32.
+
+Both handle GQA (query heads grouped over KV heads) and fp32 softmax.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def paged_attention_xla(
+    q: jnp.ndarray,  # [B, num_heads, head_dim]
+    k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    context_lens: jnp.ndarray,  # [B] int32 (valid tokens incl. current)
+    sliding_window: int | None = None,
+) -> jnp.ndarray:
+    """Reference implementation: gather blocks then masked attention."""
+    b, num_heads, head_dim = q.shape
+    _, block_size, num_kv_heads, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    group = num_heads // num_kv_heads
+
+    # [B, max_blocks, block_size, Nkv, Hd] -> [B, T, Nkv, Hd]
+    k = k_cache[block_tables].reshape(b, max_blocks * block_size, num_kv_heads, head_dim)
+    v = v_cache[block_tables].reshape(b, max_blocks * block_size, num_kv_heads, head_dim)
+
+    qg = q.reshape(b, num_kv_heads, group, head_dim).astype(jnp.float32)
+    scores = jnp.einsum('bkgd,btkd->bkgt', qg, k.astype(jnp.float32))
+    scores = scores / jnp.sqrt(jnp.float32(head_dim))
+    positions = jnp.arange(max_blocks * block_size)[None, :]
+    valid = positions < context_lens[:, None]
+    if sliding_window is not None:
+        # Match prefill's window mask: only the last `sliding_window` keys.
+        valid = valid & (positions > context_lens[:, None] - 1 - sliding_window)
+    scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bkgt,btkd->bkgd', probs, v.astype(jnp.float32))
+    return out.reshape(b, num_heads, head_dim).astype(q.dtype)
+
+
+def _paged_attn_kernel(
+    # scalar-prefetch operands
+    block_tables_ref,  # [B, max_blocks] int32 (SMEM)
+    context_lens_ref,  # [B] int32 (SMEM)
+    # array operands
+    q_ref,  # [num_heads, head_dim] (VMEM) — one sequence
+    k_cache_ref,  # [num_blocks, block_size, num_kv_heads, head_dim] (ANY/HBM)
+    v_cache_ref,
+    out_ref,  # [num_heads, head_dim]
+    *,
+    block_size: int,
+    max_blocks: int,
+    num_kv_heads: int,
+    group: int,
+):
+    """One grid program = one sequence: online softmax over its KV blocks."""
+    import jax.experimental.pallas as pl
+
+    seq = pl.program_id(0)
+    ctx = context_lens_ref[seq]
+    num_heads = q_ref.shape[0]
+    head_dim = q_ref.shape[1]
+    q = q_ref[...].astype(jnp.float32).reshape(num_kv_heads, group, head_dim)
+    scale = jax.lax.rsqrt(jnp.float32(head_dim))
+
+    def body(i, carry):
+        m, l, acc = carry  # running max, normalizer, weighted values
+        block_id = block_tables_ref[seq, i]
+        k_blk = k_cache_ref[block_id].astype(jnp.float32)  # [bs, Nkv, Hd]
+        v_blk = v_cache_ref[block_id].astype(jnp.float32)
+        scores = (
+            jnp.einsum('kgd,skd->kgs', q, k_blk, preferred_element_type=jnp.float32)
+            * scale
+        )
+        positions = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2
+        )
+        scores = jnp.where(positions < ctx, scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # Guard fully-masked blocks: exp(-inf - -inf) -> use finite correction.
+        correction = jnp.exp(jnp.where(m == -jnp.inf, 0.0, m - new_m))
+        probs = jnp.exp(scores - new_m[..., None])
+        probs = jnp.where(jnp.isfinite(scores), probs, 0.0)
+        new_l = l * correction + jnp.sum(probs, axis=-1)
+        new_acc = acc * correction[..., None] + jnp.einsum(
+            'kgs,skd->kgd', probs, v_blk, preferred_element_type=jnp.float32
+        )
+        return new_m, new_l, new_acc
+
+    n_blocks = (ctx + block_size - 1) // block_size
+    m0 = jnp.full((num_kv_heads, group), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, group), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-9)[..., None]
+    out_ref[...] = out.reshape(num_heads, head_dim).astype(out_ref.dtype)
+
+
+def paged_attention_pallas(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas TPU kernel version of :func:`paged_attention_xla`."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, num_heads, head_dim = q.shape
+    num_blocks, block_size, num_kv_heads, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    group = num_heads // num_kv_heads
+
+    kernel = functools.partial(
+        _paged_attn_kernel,
+        block_size=block_size,
+        max_blocks=max_blocks,
+        num_kv_heads=num_kv_heads,
+        group=group,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((None, num_heads, head_dim), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, num_heads, head_dim), lambda i, *_: (i, 0, 0)
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, num_heads, head_dim), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), q, k_cache, v_cache)
+
+
+def write_token_kv(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    new_k: jnp.ndarray,  # [B, num_kv_heads, head_dim]
+    new_v: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    positions: jnp.ndarray,  # [B] token index being written
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter one new token's K/V per sequence into its paged block."""
+    block_size = k_cache.shape[1]
+    batch = positions.shape[0]
+    block_ids = block_tables[jnp.arange(batch), positions // block_size]
+    offsets = positions % block_size
+    k_cache = k_cache.at[block_ids, offsets].set(new_k.astype(k_cache.dtype))
+    v_cache = v_cache.at[block_ids, offsets].set(new_v.astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+def write_prefill_kv(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k_seq: jnp.ndarray,  # [S, num_kv_heads, head_dim] one sequence's K
+    v_seq: jnp.ndarray,
+    block_table_row: jnp.ndarray,  # [max_blocks]
+    length: jnp.ndarray,  # scalar — valid tokens in k_seq
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a prefilled sequence's K/V into its blocks (pad-safe).
+
+    Padded positions (``>= length``) are routed to the TRASH BLOCK: block 0
+    is reserved by the allocator (never handed to a sequence), so garbage
+    writes land there harmlessly. Clamping to a valid slot instead would race
+    real data through XLA's nondeterministic duplicate-index scatter.
+    """
+    seq_len = k_seq.shape[0]
+    block_size = k_cache.shape[1]
+    positions = jnp.arange(seq_len)
+    valid = positions < length
+    block_ids = jnp.where(valid, block_table_row[positions // block_size], 0)
+    offsets = jnp.where(valid, positions % block_size, 0)
+    k_cache = k_cache.at[block_ids, offsets].set(k_seq.astype(k_cache.dtype))
+    v_cache = v_cache.at[block_ids, offsets].set(v_seq.astype(v_cache.dtype))
+    return k_cache, v_cache

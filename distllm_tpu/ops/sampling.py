@@ -1,0 +1,54 @@
+"""Token sampling: temperature, top-p, min-p, greedy — vectorized and jitted.
+
+Reference parity: vLLM ``SamplingParams`` as configured by
+``generate/generators/vllm_backend.py:48-60`` (temperature, max_tokens, and
+top_p XOR min_p; greedy when temperature == 0). All filtering happens on
+fp32 logits; each sequence carries its own parameters so one decode batch can
+mix sampling configs (continuous batching requirement).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _apply_top_p(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filtering per row; ``top_p >= 1`` disables."""
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # Keep the smallest prefix with cumulative >= top_p (always >= 1 token).
+    cutoff_idx = jnp.sum(cumulative < top_p[:, None], axis=-1)
+    cutoff_logit = jnp.take_along_axis(
+        sorted_logits, cutoff_idx[:, None], axis=-1
+    )
+    keep = logits >= cutoff_logit
+    return jnp.where(keep, logits, -jnp.inf)
+
+
+def _apply_min_p(logits: jnp.ndarray, min_p: jnp.ndarray) -> jnp.ndarray:
+    """Keep tokens with prob >= min_p * max_prob; ``min_p <= 0`` disables."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    threshold = min_p[:, None] * jnp.max(probs, axis=-1, keepdims=True)
+    keep = probs >= threshold
+    return jnp.where(keep, logits, -jnp.inf)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] fp32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B] (1.0 disables)
+    min_p: jnp.ndarray,  # [B] (0.0 disables)
+) -> jnp.ndarray:
+    """Per-sequence sampling; temperature == 0 rows are greedy."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_temp[:, None]
+    scaled = _apply_top_p(scaled, top_p)
+    scaled = _apply_min_p(scaled, min_p)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,336 @@
+"""Generation engine tests: paged attention, sampling, allocator, engine vs
+dense-forward golden decoding."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distllm_tpu.generate.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distllm_tpu.generate.engine.kv_cache import (
+    NativeBlockAllocator,
+    PagedKVCache,
+    PyBlockAllocator,
+)
+from distllm_tpu.models import mistral
+from distllm_tpu.ops.paged_attention import (
+    paged_attention_xla,
+    write_prefill_kv,
+    write_token_kv,
+)
+from distllm_tpu.ops.sampling import sample_tokens
+
+
+# ------------------------------------------------------------ paged attn
+def _random_cache(rng, num_blocks=8, block_size=4, nkv=2, hd=8):
+    k = rng.normal(size=(num_blocks, block_size, nkv, hd)).astype(np.float32)
+    v = rng.normal(size=(num_blocks, block_size, nkv, hd)).astype(np.float32)
+    return jnp.asarray(k), jnp.asarray(v)
+
+
+def _dense_reference(q, k, v, context_len):
+    """Plain attention over the first context_len tokens (GQA)."""
+    num_heads, hd = q.shape
+    nkv = k.shape[1]
+    group = num_heads // nkv
+    qg = q.reshape(nkv, group, hd)
+    k = k[:context_len]
+    v = v[:context_len]
+    scores = np.einsum('kgd,tkd->kgt', qg, k) / np.sqrt(hd)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    return np.einsum('kgt,tkd->kgd', probs, v).reshape(num_heads, hd)
+
+
+def test_paged_attention_matches_dense(rng):
+    block_size = 4
+    k_cache, v_cache = _random_cache(rng, block_size=block_size)
+    # seq 0 uses blocks [2, 5] with 6 tokens; seq 1 uses [7] with 3 tokens.
+    block_tables = jnp.asarray([[2, 5], [7, 0]], dtype=jnp.int32)
+    context_lens = jnp.asarray([6, 3], dtype=jnp.int32)
+    q = jnp.asarray(rng.normal(size=(2, 4, 8)).astype(np.float32))
+
+    out = np.asarray(
+        paged_attention_xla(q, k_cache, v_cache, block_tables, context_lens)
+    )
+
+    for seq, (blocks, ctx) in enumerate([((2, 5), 6), ((7,), 3)]):
+        k_lin = np.concatenate([np.asarray(k_cache[b]) for b in blocks])
+        v_lin = np.concatenate([np.asarray(v_cache[b]) for b in blocks])
+        ref = _dense_reference(np.asarray(q[seq]), k_lin, v_lin, ctx)
+        np.testing.assert_allclose(out[seq], ref, atol=1e-5, rtol=1e-4)
+
+
+def test_paged_attention_pallas_interpret_matches_xla(rng):
+    from distllm_tpu.ops.paged_attention import paged_attention_pallas
+
+    k_cache, v_cache = _random_cache(rng, num_blocks=8, block_size=4)
+    block_tables = jnp.asarray([[2, 5], [7, 0]], dtype=jnp.int32)
+    context_lens = jnp.asarray([6, 3], dtype=jnp.int32)
+    q = jnp.asarray(rng.normal(size=(2, 4, 8)).astype(np.float32))
+    ref = np.asarray(
+        paged_attention_xla(q, k_cache, v_cache, block_tables, context_lens)
+    )
+    out = np.asarray(
+        paged_attention_pallas(
+            q, k_cache, v_cache, block_tables, context_lens, interpret=True
+        )
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+
+
+def test_write_token_and_prefill_kv(rng):
+    k_cache = jnp.zeros((4, 4, 2, 3))
+    v_cache = jnp.zeros((4, 4, 2, 3))
+    # prefill 6 tokens into blocks [1, 2] (padded seq of 8)
+    k_seq = jnp.asarray(rng.normal(size=(8, 2, 3)).astype(np.float32))
+    v_seq = jnp.asarray(rng.normal(size=(8, 2, 3)).astype(np.float32))
+    row = jnp.asarray([1, 2, 0, 0], dtype=jnp.int32)
+    k_cache, v_cache = write_prefill_kv(
+        k_cache, v_cache, k_seq, v_seq, row, jnp.int32(6)
+    )
+    np.testing.assert_allclose(np.asarray(k_cache[1]), np.asarray(k_seq[:4]))
+    np.testing.assert_allclose(np.asarray(k_cache[2][:2]), np.asarray(k_seq[4:6]))
+    # slot beyond length stays zero (trash block ate the padding)
+    np.testing.assert_allclose(np.asarray(k_cache[2][2:]), 0.0)
+
+    # token write at position 6 -> block row[6//4]=2, offset 2
+    new_k = jnp.ones((1, 2, 3))
+    new_v = jnp.ones((1, 2, 3)) * 2
+    k_cache, v_cache = write_token_kv(
+        k_cache, v_cache, new_k, new_v,
+        jnp.asarray([[1, 2, 0, 0]], dtype=jnp.int32),
+        jnp.asarray([6], dtype=jnp.int32),
+    )
+    np.testing.assert_allclose(np.asarray(k_cache[2][2]), 1.0)
+    np.testing.assert_allclose(np.asarray(v_cache[2][2]), 2.0)
+
+
+# -------------------------------------------------------------- sampling
+def test_sampling_greedy():
+    logits = jnp.asarray([[0.0, 5.0, 1.0], [3.0, 0.0, 0.1]])
+    toks = sample_tokens(
+        logits,
+        jax.random.PRNGKey(0),
+        temperature=jnp.zeros(2),
+        top_p=jnp.ones(2),
+        min_p=jnp.zeros(2),
+    )
+    assert list(np.asarray(toks)) == [1, 0]
+
+
+def test_sampling_top_p_restricts_support():
+    # One dominant token (p≈0.87); top_p=0.5 must always pick it.
+    logits = jnp.tile(jnp.asarray([[4.0, 2.0, 0.0, -1.0]]), (64, 1))
+    toks = sample_tokens(
+        logits,
+        jax.random.PRNGKey(1),
+        temperature=jnp.ones(64),
+        top_p=jnp.full(64, 0.5),
+        min_p=jnp.zeros(64),
+    )
+    assert set(np.asarray(toks).tolist()) == {0}
+
+
+def test_sampling_min_p_restricts_support():
+    logits = jnp.tile(jnp.asarray([[4.0, 3.5, -8.0, -9.0]]), (128, 1))
+    toks = np.asarray(
+        sample_tokens(
+            logits,
+            jax.random.PRNGKey(2),
+            temperature=jnp.ones(128),
+            top_p=jnp.ones(128),
+            min_p=jnp.full(128, 0.2),
+        )
+    )
+    assert set(toks.tolist()) <= {0, 1}
+    assert len(set(toks.tolist())) == 2  # still samples, not greedy
+
+
+# -------------------------------------------------------------- allocator
+@pytest.mark.parametrize('cls', [PyBlockAllocator, NativeBlockAllocator])
+def test_block_allocator(cls):
+    try:
+        alloc = cls(8)
+    except RuntimeError:
+        pytest.skip('native toolchain unavailable')
+    assert alloc.num_free == 7  # block 0 reserved
+    blocks = [alloc.alloc() for _ in range(7)]
+    assert 0 not in blocks
+    assert alloc.alloc() == -1  # exhausted
+    alloc.incref(blocks[0])
+    alloc.free(blocks[0])
+    assert alloc.num_free == 0  # still referenced
+    alloc.free(blocks[0])
+    assert alloc.num_free == 1
+    with pytest.raises((AssertionError, ValueError)):
+        alloc.free(blocks[0])  # double free
+
+
+def test_paged_kv_cache_bookkeeping():
+    kv = PagedKVCache(
+        num_layers=2, num_blocks=8, block_size=4, num_kv_heads=2,
+        head_dim=4, dtype='float32', prefer_native_allocator=False,
+    )
+    blocks = kv.allocate_sequence(10)  # 3 blocks
+    assert len(blocks) == 3
+    assert kv.extend_sequence(blocks, 13)  # 4th block
+    assert len(blocks) == 4
+    kv.free_sequence(blocks)
+    assert kv.allocator.num_free == 7
+
+
+# ----------------------------------------------------------------- engine
+def _tiny_engine(num_blocks=64, max_num_seqs=4, max_model_len=64):
+    cfg = mistral.MistralConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        intermediate_size=64,
+        dtype='float32',
+    )
+    params = mistral.init(jax.random.PRNGKey(0), cfg)
+
+    class IdTokenizer:
+        eos_id = None
+
+        def decode(self, ids):
+            return ' '.join(str(i) for i in ids)
+
+    engine = LLMEngine(
+        cfg,
+        params,
+        IdTokenizer(),
+        EngineConfig(
+            block_size=4,
+            num_blocks=num_blocks,
+            max_num_seqs=max_num_seqs,
+            max_model_len=max_model_len,
+            prefer_native_allocator=False,
+        ),
+    )
+    return cfg, params, engine
+
+
+def _dense_greedy_reference(cfg, params, prompt, n_tokens):
+    """Greedy decoding via full dense re-forward each step (gold path)."""
+    ids = list(prompt)
+    for _ in range(n_tokens):
+        arr = np.asarray([ids], np.int32)
+        mask = np.ones_like(arr)
+        hidden = mistral.apply(params, cfg, arr, mask)
+        lg = mistral.logits(params, cfg, hidden[:, -1])
+        ids.append(int(np.argmax(np.asarray(lg)[0])))
+    return ids[len(prompt):]
+
+
+def test_engine_greedy_matches_dense_forward():
+    cfg, params, engine = _tiny_engine()
+    prompts = [[5, 9, 12], [7, 3, 22, 31, 40, 2, 17], [1, 2, 3, 4, 5]]
+    n = 8
+    params_greedy = SamplingParams(temperature=0.0, max_tokens=n)
+    outs = engine.generate_ids(prompts, params_greedy)
+    for prompt, out in zip(prompts, outs):
+        ref = _dense_greedy_reference(cfg, params, prompt, n)
+        assert out == ref, f'{out} != {ref}'
+
+
+def test_engine_continuous_batching_join_leave():
+    """Requests with different lengths join/leave the batch mid-flight."""
+    cfg, params, engine = _tiny_engine(max_num_seqs=2)
+    sp_short = SamplingParams(temperature=0.0, max_tokens=2)
+    sp_long = SamplingParams(temperature=0.0, max_tokens=6)
+    r1 = engine.add_request([5, 6, 7], sp_long)
+    r2 = engine.add_request([9, 8], sp_short)
+    r3 = engine.add_request([11, 12, 13], sp_short)  # waits for a slot
+    seen = {}
+    while engine.has_unfinished:
+        for rid, tok in engine.step():
+            seen.setdefault(rid, []).append(tok)
+    assert len(seen[r1]) == 6
+    assert len(seen[r2]) == 2
+    assert len(seen[r3]) == 2
+    # all finished requests got their outputs recorded & slots/blocks freed
+    assert all(r is None for r in engine._slots)
+    ref = _dense_greedy_reference(cfg, params, [5, 6, 7], 6)
+    assert seen[r1] == ref
+
+
+def test_engine_preemption_under_block_pressure():
+    """Tiny block pool forces recompute preemption; outputs still correct
+    and complete (no tokens lost across preemption)."""
+    # 7 usable blocks, 3 seqs needing 3 blocks each -> guaranteed pressure.
+    cfg, params, engine = _tiny_engine(num_blocks=8, max_num_seqs=3, max_model_len=32)
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+    prompts = [[5, 9, 12, 4], [7, 3, 22, 31], [1, 2, 3, 4]]
+    outs = engine.generate_ids(prompts, sp)
+    for prompt, out in zip(prompts, outs):
+        ref = _dense_greedy_reference(cfg, params, prompt, 6)
+        assert out == ref
+    # No block leaks: everything freed at the end.
+    assert engine.kv.allocator.num_free == 7
+
+
+def test_engine_prompt_at_max_model_len():
+    """A prompt >= max_model_len truncates (keeping the tail) and still runs."""
+    cfg, params, engine = _tiny_engine(num_blocks=64, max_model_len=16)
+    sp = SamplingParams(temperature=0.0, max_tokens=2)
+    prompt = list(range(1, 41))  # 40 tokens, max_model_len 16
+    out = engine.generate_ids([prompt], sp)[0]
+    ref = _dense_greedy_reference(cfg, params, prompt[-15:], 1)
+    assert out[0] == ref[0]
+
+
+def test_engine_unadmittable_prompt_raises():
+    cfg, params, engine = _tiny_engine(num_blocks=4, max_model_len=32)
+    with pytest.raises(ValueError, match='KV blocks'):
+        engine.add_request(list(range(1, 30)))
+
+
+def test_decode_sliding_window_matches_dense():
+    """Sliding-window decode must equal dense forward with the window mask."""
+    cfg = mistral.MistralConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        intermediate_size=64,
+        sliding_window=4,
+        dtype='float32',
+    )
+    params = mistral.init(jax.random.PRNGKey(3), cfg)
+
+    class IdTok:
+        eos_id = None
+
+        def decode(self, ids):
+            return ''
+
+    engine = LLMEngine(
+        cfg, params, IdTok(),
+        EngineConfig(
+            block_size=4, num_blocks=32, max_num_seqs=2, max_model_len=32,
+            prefer_native_allocator=False,
+        ),
+    )
+    prompt = [5, 9, 12, 4, 7, 3]
+    out = engine.generate_ids([prompt], SamplingParams(temperature=0.0, max_tokens=5))[0]
+    ref = _dense_greedy_reference(cfg, params, prompt, 5)
+    assert out == ref
+
+
+def test_engine_stop_tokens():
+    cfg, params, engine = _tiny_engine()
+    ref = _dense_greedy_reference(cfg, params, [5, 9, 12], 8)
+    stop = ref[3]
+    sp = SamplingParams(temperature=0.0, max_tokens=20, stop_token_ids=(stop,))
+    out = engine.generate_ids([[5, 9, 12]], sp)[0]
+    assert out == ref[: ref.index(stop)]  # truncated at stop, token stripped

@@ -1,0 +1,230 @@
+"""Generate pipeline tests: readers, prompts, writers, distributed driver."""
+
+import json
+
+import pytest
+
+from distllm_tpu.generate import (
+    get_generator,
+    get_prompt_template,
+    get_reader,
+    get_writer,
+)
+
+
+# ---------------------------------------------------------------- readers
+def test_jsonl_reader(tmp_path):
+    f = tmp_path / 'in.jsonl'
+    f.write_text(
+        json.dumps({'text': 'hello', 'path': 'p1'})
+        + '\n'
+        + json.dumps({'text': 'world'})
+        + '\n'
+    )
+    texts, paths = get_reader({'name': 'jsonl'}).read(f)
+    assert texts == ['hello', 'world']
+    assert paths == ['p1', str(f)]
+
+
+def test_huggingface_reader(tmp_path):
+    from datasets import Dataset
+
+    Dataset.from_dict({'text': ['a', 'b'], 'path': ['x', 'y']}).save_to_disk(
+        str(tmp_path / 'ds')
+    )
+    texts, paths = get_reader({'name': 'huggingface'}).read(tmp_path / 'ds')
+    assert texts == ['a', 'b']
+    assert paths == ['x', 'y']
+
+
+def test_amp_json_reader(tmp_path):
+    f = tmp_path / 'amp.json'
+    f.write_text(
+        json.dumps(
+            {
+                'groupA': [{'Protein_Name': 'P1', 'Function': 'binds stuff'}],
+                'groupB': [{'Protein_Name': 'P2', 'Function': 'cuts stuff'}],
+            }
+        )
+    )
+    texts, paths = get_reader({'name': 'amp_json'}).read(f)
+    assert len(texts) == 2
+    assert texts == paths
+    assert json.loads(texts[0])['Protein_Name'] == 'P1'
+
+
+# ---------------------------------------------------------------- prompts
+def test_identity_prompt():
+    pt = get_prompt_template({'name': 'identity'})
+    assert pt.preprocess('abc') == ['abc']
+    assert pt.postprocess(['x']) == ['x']
+
+
+def test_question_chunk_prompt():
+    pt = get_prompt_template({'name': 'question_chunk'})
+    prompts = pt.preprocess(['some science text'])
+    assert 'some science text' in prompts[0]
+    out = pt.postprocess(
+        ['Here is context. What drives protein folding? Another statement.']
+    )
+    assert out == ['What drives protein folding?']
+    assert pt.postprocess(['No questions here.']) == ['']
+
+
+def test_question_answer_prompt():
+    pt = get_prompt_template({'name': 'question_answer'})
+    with_ctx = pt.preprocess(
+        ['Which is true?'], contexts=[['ctx one']], scores=[[0.9]]
+    )
+    assert 'Context (with relevance scores)' in with_ctx[0]
+    assert 'score: 0.9' in with_ctx[0]
+    no_ctx = pt.preprocess(['Which is true?'])
+    assert 'Context' not in no_ctx[0]
+    assert pt.postprocess(['2. The Answer.']) == ['the answer']
+    assert pt.postprocess(['Plain']) == ['plain']
+
+
+def test_keyword_selection_prompt(tmp_path):
+    kw = tmp_path / 'kw.txt'
+    kw.write_text('radiation\ndosimetry\nbiology\n')
+    pt = get_prompt_template({'name': 'keyword_selection', 'keywords': kw})
+    prompts = pt.preprocess(['a paragraph'])
+    assert 'dosimetry' in prompts[0]
+    pt2 = get_prompt_template(
+        {'name': 'keyword_selection', 'keywords': ['a', 'b']}
+    )
+    assert pt2.keywords_list == ['a', 'b']
+
+
+def test_amp_question_prompt_roundtrip():
+    pt = get_prompt_template({'name': 'amp_question'})
+    entry = json.dumps({'Protein_Name': 'LL-37', 'Function': 'antimicrobial'})
+    prompts = pt.preprocess([entry])
+    assert 'LL-37' in prompts[0]
+    response = (
+        'Sure!\nQuestion: What does LL-37 do? '
+        'A) Kills microbes B) Stores iron C) Binds DNA D) Nothing '
+        'Answer: A) Kills microbes'
+    )
+    parsed = json.loads(pt.postprocess([response])[0])
+    assert parsed['correct_answer'] == 'Kills microbes'
+    assert len(parsed['distractors']) == 3
+    assert 'What does LL-37 do?' in parsed['full_question_text']
+    # Unparseable response -> null fields
+    bad = json.loads(pt.postprocess(['gibberish'])[0])
+    assert bad['correct_answer'] is None
+
+
+# -------------------------------------------------------------- generators
+def test_fake_generator():
+    gen = get_generator({'name': 'fake'})
+    out = gen.generate(['one', 'two'])
+    assert out == ['response to: one', 'response to: two']
+
+
+def test_tpu_generator_config_xor():
+    from distllm_tpu.generate.generators.tpu_backend import TpuGeneratorConfig
+
+    with pytest.raises(ValueError, match='top_p or min_p'):
+        TpuGeneratorConfig(
+            pretrained_model_name_or_path='/x', top_p=0.9, min_p=0.1
+        )
+    cfg = TpuGeneratorConfig(pretrained_model_name_or_path='/x', name='vllm')
+    assert cfg.min_p == 0.1
+
+
+def test_unknown_generator():
+    with pytest.raises(ValueError, match='Unknown generator'):
+        get_generator({'name': 'bogus'})
+
+
+# ---------------------------------------------------------------- writers
+def test_hf_generate_writer_and_merge(tmp_path):
+    from datasets import load_from_disk
+
+    writer = get_writer({'name': 'huggingface'})
+    writer.write(tmp_path / 's1', ['p1'], ['t1'], ['r1'])
+    writer.write(tmp_path / 's2', ['p2'], ['t2'], ['r2'])
+    writer.merge(
+        [tmp_path / 's1', tmp_path / 's2', tmp_path / 'gone'], tmp_path / 'm'
+    )
+    ds = load_from_disk(str(tmp_path / 'm'))
+    assert sorted(ds['response']) == ['r1', 'r2']
+
+
+def test_amp_jsonl_writer(tmp_path):
+    writer = get_writer({'name': 'amp_jsonl'})
+    entry = json.dumps({'Protein_Name': 'P1', 'Function': 'x'})
+    response = json.dumps({'correct_answer': 'A'})
+    writer.write(tmp_path / 's1', [entry], [entry], [response])
+    lines = (
+        (tmp_path / 's1' / 'amp_questions_0.jsonl').read_text().splitlines()
+    )
+    merged = json.loads(lines[0])
+    assert merged['Protein_Name'] == 'P1'
+    assert merged['correct_answer'] == 'A'
+    writer.merge([tmp_path / 's1'], tmp_path / 'm')
+    assert (tmp_path / 'm' / 'amp_questions_merged.jsonl').exists()
+
+
+# ----------------------------------------------------------------- driver
+def test_distributed_generation_end_to_end(tmp_path):
+    import yaml
+
+    from distllm_tpu.distributed_generation import main
+    from distllm_tpu.registry import registry
+
+    input_dir = tmp_path / 'in'
+    input_dir.mkdir()
+    for i in range(2):
+        with open(input_dir / f'f{i}.jsonl', 'w') as fh:
+            fh.write(json.dumps({'text': f'chunk {i}', 'path': f'p{i}'}) + '\n')
+
+    config = {
+        'input_dir': str(input_dir),
+        'output_dir': str(tmp_path / 'out'),
+        'glob_patterns': ['*.jsonl'],
+        'reader_config': {'name': 'jsonl'},
+        'prompt_config': {'name': 'identity'},
+        'generator_config': {'name': 'fake'},
+        'writer_config': {'name': 'huggingface'},
+        'compute_config': {'name': 'local'},
+    }
+    cfg_path = tmp_path / 'gen.yaml'
+    cfg_path.write_text(yaml.safe_dump(config))
+    assert main(['--config', str(cfg_path)]) == 0
+    shards = sorted((tmp_path / 'out' / 'generations').iterdir())
+    assert len(shards) == 2
+    # Clobber guard: second run refuses.
+    assert main(['--config', str(cfg_path)]) == 1
+    registry().clear()
+
+
+def test_distributed_tokenization_worker(tmp_path):
+    """Worker-level test with a local tokenizer dir (no hub access)."""
+    from datasets import load_from_disk
+    from transformers import BertTokenizerFast
+
+    # Build a tiny local WordPiece vocab.
+    vocab = ['[PAD]', '[UNK]', '[CLS]', '[SEP]', 'hello', 'world']
+    vocab_file = tmp_path / 'vocab.txt'
+    vocab_file.write_text('\n'.join(vocab))
+    tok = BertTokenizerFast(vocab_file=str(vocab_file))
+    tok.save_pretrained(str(tmp_path / 'tok'))
+
+    f = tmp_path / 'in.jsonl'
+    f.write_text(json.dumps({'text': 'hello world'}) + '\n')
+
+    from distllm_tpu.distributed_tokenization import tokenizer_worker
+
+    shard = tokenizer_worker(
+        str(f),
+        output_dir=str(tmp_path / 'out'),
+        tokenizer_kwargs={
+            'tokenizer_name_or_path': str(tmp_path / 'tok'),
+            'return_labels': True,
+        },
+    )
+    ds = load_from_disk(shard)
+    assert ds[0]['input_ids'][0] == 2  # [CLS]
+    assert ds[0]['labels'] == ds[0]['input_ids']
