@@ -1,0 +1,164 @@
+"""OpenAI-compatible RAG chat server (aiohttp).
+
+Reference parity: ``distllm/chat_server.py`` — ``POST /v1/chat/completions``
+plus ``GET /health``; OpenAI messages are folded into the conversation
+template; RAG runs in a worker thread (the event loop stays free); optional
+single-delta SSE streaming; request extensions ``top_k`` and
+``score_threshold``; config path from the ``DISTLLM_CHAT_CONFIG`` env var;
+permissive CORS. FastAPI is unavailable in this environment, so the server
+is aiohttp.
+
+Run: ``DISTLLM_CHAT_CONFIG=cfg.yaml python -m distllm_tpu.chat_server --port 8000``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+import uuid
+
+from distllm_tpu.chat import ChatAppConfig, ChatSession
+
+
+def _completion_payload(model: str, content: str) -> dict:
+    return {
+        'id': f'chatcmpl-{uuid.uuid4().hex[:24]}',
+        'object': 'chat.completion',
+        'created': int(time.time()),
+        'model': model,
+        'choices': [
+            {
+                'index': 0,
+                'message': {'role': 'assistant', 'content': content},
+                'finish_reason': 'stop',
+            }
+        ],
+        'usage': {
+            'prompt_tokens': 0,
+            'completion_tokens': 0,
+            'total_tokens': 0,
+        },
+    }
+
+
+def build_app(config: ChatAppConfig):
+    from concurrent.futures import ThreadPoolExecutor
+
+    from aiohttp import web
+
+    session = ChatSession(config)
+    template = session.template
+    # Single-worker executor: the engine's scheduler/paged-KV state is NOT
+    # thread-safe; concurrency comes from the engine's continuous batching,
+    # not from parallel Python threads.
+    executor = ThreadPoolExecutor(max_workers=1)
+
+    def answer(messages, top_k, score_threshold):
+        """Stateless per-request RAG (history comes from the client)."""
+        latest = next(
+            (m['content'] for m in reversed(messages) if m['role'] == 'user'),
+            '',
+        )
+        contexts, scores = [], []
+        if session.retriever is not None and latest:
+            results, _ = session.retriever.search(
+                latest, top_k=top_k, score_threshold=score_threshold
+            )
+            indices = results.total_indices[0]
+            contexts = (
+                session.retriever.get_texts(indices) if indices else []
+            )
+            scores = results.total_scores[0]
+        prompt = template.render(list(messages), contexts, scores)
+        return session.generator.generate([prompt])[0]
+
+    async def chat_completions(request: 'web.Request') -> 'web.StreamResponse':
+        body = await request.json()
+        messages = body.get('messages', [])
+        if not messages:
+            return web.json_response(
+                {'error': {'message': 'messages is required'}}, status=400
+            )
+        top_k = int(body.get('top_k', config.retrieval_top_k))
+        score_threshold = float(
+            body.get('score_threshold', config.retrieval_score_threshold)
+        )
+        model = body.get('model', 'distllm-tpu')
+        loop = asyncio.get_running_loop()
+        content = await loop.run_in_executor(
+            executor, answer, messages, top_k, score_threshold
+        )
+        if body.get('stream'):
+            # Single-delta SSE streaming (reference ``chat_server.py:168-270``).
+            response = web.StreamResponse(
+                headers={
+                    'Content-Type': 'text/event-stream',
+                    'Cache-Control': 'no-cache',
+                }
+            )
+            await response.prepare(request)
+            chunk = {
+                'id': f'chatcmpl-{uuid.uuid4().hex[:24]}',
+                'object': 'chat.completion.chunk',
+                'created': int(time.time()),
+                'model': model,
+                'choices': [
+                    {
+                        'index': 0,
+                        'delta': {'role': 'assistant', 'content': content},
+                        'finish_reason': 'stop',
+                    }
+                ],
+            }
+            await response.write(
+                f'data: {json.dumps(chunk)}\n\n'.encode()
+            )
+            await response.write(b'data: [DONE]\n\n')
+            await response.write_eof()
+            return response
+        return web.json_response(_completion_payload(model, content))
+
+    async def health(request: 'web.Request') -> 'web.Response':
+        return web.json_response({'status': 'ok'})
+
+    async def preflight(request: 'web.Request') -> 'web.Response':
+        return web.Response(status=204)
+
+    @web.middleware
+    async def cors(request, handler):
+        response = await handler(request)
+        response.headers['Access-Control-Allow-Origin'] = '*'
+        response.headers['Access-Control-Allow-Headers'] = '*'
+        response.headers['Access-Control-Allow-Methods'] = 'GET, POST, OPTIONS'
+        return response
+
+    app = web.Application(middlewares=[cors])
+    app.router.add_post('/v1/chat/completions', chat_completions)
+    app.router.add_get('/health', health)
+    # Browser preflight for any path (CORS headers added by the middleware).
+    app.router.add_route('OPTIONS', '/{tail:.*}', preflight)
+    return app
+
+
+def main(argv: list[str] | None = None) -> int:
+    from aiohttp import web
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--config', type=str, default=None)
+    parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--port', type=int, default=8000)
+    args = parser.parse_args(argv)
+
+    config_path = args.config or os.environ.get('DISTLLM_CHAT_CONFIG')
+    config = (
+        ChatAppConfig.from_yaml(config_path) if config_path else ChatAppConfig()
+    )
+    web.run_app(build_app(config), host=args.host, port=args.port)
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

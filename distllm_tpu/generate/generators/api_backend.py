@@ -22,6 +22,9 @@ class ApiGeneratorConfig(BaseConfig):
     name: Literal['api', 'langchain'] = 'api'
     openai_api_base: str = 'https://api.openai.com/v1'
     model: str = 'gpt-3.5-turbo'
+    api_key: str = Field(
+        default='', description='Inline API key (takes precedence).'
+    )
     api_key_env: str = Field(
         default='OPENAI_API_KEY', description='Env var holding the API key.'
     )
@@ -29,6 +32,11 @@ class ApiGeneratorConfig(BaseConfig):
     max_tokens: int = 512
     timeout: float = 120.0
     max_tries: int = 5
+    extra_body: dict = Field(
+        default_factory=dict,
+        description='Extra JSON merged into each request (e.g. Argo-proxy '
+        "style 'user' fields).",
+    )
 
 
 class ApiGenerator:
@@ -39,7 +47,9 @@ class ApiGenerator:
         import requests
 
         headers = {'Content-Type': 'application/json'}
-        api_key = os.environ.get(self.config.api_key_env, '')
+        api_key = self.config.api_key or os.environ.get(
+            self.config.api_key_env, ''
+        )
         if api_key:
             headers['Authorization'] = f'Bearer {api_key}'
 
@@ -51,6 +61,7 @@ class ApiGenerator:
                     'messages': [{'role': 'user', 'content': prompt}],
                     'temperature': self.config.temperature,
                     'max_tokens': self.config.max_tokens,
+                    **self.config.extra_body,
                 },
                 headers=headers,
                 timeout=self.config.timeout,

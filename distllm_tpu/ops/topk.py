@@ -1,0 +1,111 @@
+"""Exact inner-product top-k over device-sharded corpora.
+
+The FAISS replacement's compute core (SURVEY.md section 2.4 N2): embeddings
+live row-sharded across chips (mesh ``data`` axis); each chip computes its
+shard's ``Q @ E_shard^T`` on the MXU and a local ``lax.top_k``; the per-shard
+candidates (k per chip) are concatenated — a tiny ICI all-gather instead of
+gathering the full ``[B, N]`` score matrix — and reduced with one final
+``top_k``. Also hosts the binary (Hamming) scoring path used by ubinary
+quantized indexes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def topk_inner_product(
+    queries: jnp.ndarray,  # [B, H] fp32
+    corpus: jnp.ndarray,  # [N, H] (possibly sharded over mesh 'data')
+    k: int,
+    mesh: Mesh | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact top-k by inner product. Returns (scores [B, k], indices [B, k])."""
+    k = min(k, corpus.shape[0])
+    if mesh is None or mesh.shape.get('data', 1) == 1:
+        scores = queries @ corpus.T
+        return jax.lax.top_k(scores, k)
+    return _topk_sharded(queries, corpus, k, mesh)
+
+
+def _topk_sharded(queries, corpus, k, mesh):
+    from jax import shard_map
+
+    n_shards = mesh.shape['data']
+    shard_rows = corpus.shape[0] // n_shards
+
+    def per_shard(q, e_shard):
+        scores = q @ e_shard.T  # [B, n/shards] on-chip MXU matmul
+        local_k = min(k, e_shard.shape[0])
+        s, i = jax.lax.top_k(scores, local_k)
+        offset = jax.lax.axis_index('data') * shard_rows
+        return s, i + offset
+
+    sharded = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P('data', None)),
+        out_specs=(P(None, 'data'), P(None, 'data')),
+    )
+    cand_scores, cand_idx = sharded(queries, corpus)  # [B, k*shards]
+    merged_scores, merged_pos = jax.lax.top_k(cand_scores, k)
+    merged_idx = jnp.take_along_axis(cand_idx, merged_pos, axis=1)
+    return merged_scores, merged_idx
+
+
+def pack_sign_bits(embeddings: np.ndarray) -> np.ndarray:
+    """fp32 ``[N, H]`` → uint8 ``[N, H/8]`` sign-bit packing (ubinary).
+
+    Matches sentence-transformers' ``quantize_embeddings(..., 'ubinary')``:
+    bit = 1 where value > 0, packed big-endian within each byte.
+    """
+    if embeddings.shape[1] % 8 != 0:
+        raise ValueError(f'embedding dim {embeddings.shape[1]} not divisible by 8')
+    bits = (embeddings > 0).astype(np.uint8)
+    return np.packbits(bits, axis=1)
+
+
+def hamming_topk(
+    query_bits: jnp.ndarray,  # [B, H/8] uint8
+    corpus_bits: jnp.ndarray,  # [N, H/8] uint8
+    k: int,
+    chunk_size: int = 1 << 16,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k by smallest Hamming distance. Returns (distances, indices).
+
+    The corpus axis is processed in chunks with a running top-k so peak
+    memory is ``O(B * chunk_size)`` — ubinary indexes exist precisely for
+    corpora too large to materialize ``[B, N, H/8]`` intermediates.
+    """
+    n = corpus_bits.shape[0]
+    k = min(k, n)
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def chunk_distances(q, corpus_chunk, chunk_k):
+        xor = jnp.bitwise_xor(q[:, None, :], corpus_chunk[None, :, :])
+        distances = jnp.sum(
+            jax.lax.population_count(xor).astype(jnp.int32), axis=-1
+        )
+        neg, idx = jax.lax.top_k(-distances, chunk_k)
+        return neg, idx
+
+    best_neg = None
+    best_idx = None
+    for start in range(0, n, chunk_size):
+        chunk = corpus_bits[start : start + chunk_size]
+        chunk_k = min(k, chunk.shape[0])
+        neg, idx = chunk_distances(query_bits, chunk, chunk_k)
+        idx = idx + start
+        if best_neg is None:
+            best_neg, best_idx = neg, idx
+        else:
+            cat_neg = jnp.concatenate([best_neg, neg], axis=1)
+            cat_idx = jnp.concatenate([best_idx, idx], axis=1)
+            best_neg, pos = jax.lax.top_k(cat_neg, k)
+            best_idx = jnp.take_along_axis(cat_idx, pos, axis=1)
+    return -best_neg, best_idx

@@ -1,0 +1,2 @@
+"""RAG layer: sharded semantic search index, retriever, response synthesis,
+and QA evaluation tasks (reference: ``distllm/rag/``)."""

@@ -1,0 +1,77 @@
+"""Evaluation suite: models × tasks grid (reference: ``distllm/rag/evaluate.py``).
+
+Run: ``python -m distllm_tpu.rag.evaluate --config eval.yaml``
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+from typing import Any
+
+from distllm_tpu.rag.tasks import get_task
+from distllm_tpu.utils import BaseConfig
+
+
+class RetrievalAugmentedGenerationConfig(BaseConfig):
+    """One RAG setup: a generator plus an optional retriever.
+
+    Parity with ``rag/evaluate.py:18-45``.
+    """
+
+    generator_config: dict[str, Any]
+    retriever_config: dict[str, Any] | None = None
+    retrieval_top_k: int = 5
+    retrieval_score_threshold: float = 0.0
+
+    def get_rag_generator(self, register: bool = True):
+        from distllm_tpu.generate import get_generator
+        from distllm_tpu.rag.response_synthesizer import RagGenerator
+        from distllm_tpu.rag.search import RetrieverConfig
+
+        generator = get_generator(self.generator_config, register=register)
+        retriever = None
+        if self.retriever_config is not None:
+            retriever = RetrieverConfig(**self.retriever_config).get_retriever(
+                register=register
+            )
+        return RagGenerator(generator=generator, retriever=retriever)
+
+
+class EvalSuiteConfig(BaseConfig):
+    """Parity with ``EvalSuiteConfig`` (``rag/evaluate.py``)."""
+
+    rag_configs: list[RetrievalAugmentedGenerationConfig]
+    tasks: list[str]
+    download_dir: Path
+    output_path: Path | None = None
+
+
+def run_eval_suite(config: EvalSuiteConfig) -> dict[str, dict[str, Any]]:
+    """Evaluate every rag_config on every task; returns nested results."""
+    results: dict[str, dict[str, Any]] = {}
+    for model_idx, rag_config in enumerate(config.rag_configs):
+        generator = rag_config.get_rag_generator()
+        for task_name in config.tasks:
+            task = get_task(task_name, config.download_dir)
+            metrics = task.evaluate(generator)
+            results.setdefault(f'model_{model_idx}', {})[task_name] = metrics
+            print(f'[eval] model_{model_idx} {task_name}: {metrics}')
+    if config.output_path is not None:
+        import json
+
+        config.output_path.parent.mkdir(parents=True, exist_ok=True)
+        config.output_path.write_text(json.dumps(results, indent=2))
+    return results
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--config', required=True, type=Path)
+    args = parser.parse_args(argv)
+    run_eval_suite(EvalSuiteConfig.from_yaml(args.config))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -1,0 +1,58 @@
+"""RAG response synthesis: retrieve → prompt → generate → postprocess.
+
+Reference parity: ``distllm/rag/response_synthesizer.py:18-92`` — with no
+retriever attached the generator runs as the no-RAG baseline; with one, each
+query's top-k texts and scores are passed to the prompt template.
+"""
+
+from __future__ import annotations
+
+from distllm_tpu.generate.generators.base import LLMGenerator
+from distllm_tpu.generate.prompts import get_prompt_template
+from distllm_tpu.generate.prompts.base import PromptTemplate
+from distllm_tpu.rag.search import Retriever
+
+
+class RagGenerator:
+    """Generate responses to queries with optional retrieval augmentation."""
+
+    def __init__(
+        self,
+        generator: LLMGenerator,
+        retriever: Retriever | None = None,
+    ) -> None:
+        self.generator = generator
+        self.retriever = retriever
+
+    def generate(
+        self,
+        texts: str | list[str],
+        prompt_template: PromptTemplate | None = None,
+        retrieval_top_k: int = 5,
+        retrieval_score_threshold: float = 0.0,
+    ) -> list[str]:
+        if isinstance(texts, str):
+            texts = [texts]
+        if prompt_template is None:
+            prompt_template = get_prompt_template({'name': 'identity'})
+
+        contexts, scores = None, None
+        if self.retriever is not None:
+            results, _ = self.retriever.search(
+                texts,
+                top_k=retrieval_top_k,
+                score_threshold=retrieval_score_threshold,
+            )
+            contexts = [
+                self.retriever.get_texts(indices)
+                for indices in results.total_indices
+            ]
+            scores = results.total_scores
+
+        prompts = prompt_template.preprocess(texts, contexts, scores)
+        responses = self.generator.generate(prompts)
+        responses = prompt_template.postprocess(responses)
+        assert len(texts) == len(responses), (
+            'Mismatch between queries and responses.'
+        )
+        return responses

@@ -1,0 +1,275 @@
+"""Semantic similarity search: sharded TPU index + Retriever.
+
+TPU-native replacement for the reference's FAISS stack
+(``distllm/rag/search.py``; SURVEY.md section 2.4 N2):
+
+- :class:`TpuIndexV2` mirrors ``FaissIndexV2``'s surface — build-if-missing
+  from an embeddings dataset, persist to disk, precision ``float32`` (exact
+  inner product, MXU matmul + ``lax.top_k``, multi-chip via shard_map) or
+  ``ubinary`` (sign-bit packed, Hamming search + fp32 **rescore** with
+  ``rescore_multiplier`` oversampling, same semantics as
+  sentence-transformers' ``semantic_search_faiss`` path, ``search.py:314-322``),
+  score-threshold filtering, and row access ``get(indices, key)``.
+  ``index_type`` accepts the reference's HNSW names but serves them with the
+  exact search (on TPU the brute-force matmul IS the fast path; approximate
+  graphs are a CPU workaround).
+- :class:`TpuIndexV1` — deprecated V1 surface kept for config compatibility
+  (``search.py:402-666``), same engine underneath.
+- :class:`Retriever` — query path with sort-by-length batching, encoder +
+  pooler, L2 normalization, order restoration (``search.py:743-928``).
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Literal
+
+import jax.numpy as jnp
+import numpy as np
+from pydantic import Field
+
+from distllm_tpu.embed.encoders.base import Encoder
+from distllm_tpu.embed.poolers.base import Pooler
+from distllm_tpu.ops.topk import hamming_topk, pack_sign_bits, topk_inner_product
+from distllm_tpu.utils import BaseConfig
+
+
+@dataclass
+class BatchedSearchResults:
+    """Parity with the reference's result container (``search.py:26-31``)."""
+
+    total_indices: list[list[int]]
+    total_scores: list[list[float]]
+
+
+def _load_embeddings_dataset(dataset_dir: str | Path):
+    from datasets import load_from_disk
+
+    return load_from_disk(str(dataset_dir))
+
+
+class TpuIndexV2Config(BaseConfig):
+    name: Literal['tpu_index_v2', 'faiss_index_v2'] = 'tpu_index_v2'
+    dataset_dir: Path
+    index_dir: Path | None = Field(
+        default=None,
+        description='Where the packed index file lives; defaults to '
+        'dataset_dir/tpu_index.',
+    )
+    index_type: str = Field(
+        default='flat',
+        description="'flat' (exact) — 'hnsw*' names accepted and served "
+        'exactly (TPU brute force beats CPU graphs).',
+    )
+    precision: Literal['float32', 'ubinary'] = 'float32'
+    rescore_multiplier: int = Field(
+        default=4,
+        description='ubinary: oversample factor before fp32 rescoring.',
+    )
+    metric: Literal['inner_product'] = 'inner_product'
+    normalize: bool = Field(
+        default=True, description='L2-normalize embeddings (cosine/IP).'
+    )
+    mesh: dict | None = Field(
+        default=None,
+        description='MeshSpec kwargs (e.g. {"data": -1}) to shard the corpus '
+        'over chips; None = single device.',
+    )
+
+    def get_index(self) -> 'TpuIndexV2':
+        mesh = None
+        if self.mesh is not None:
+            from distllm_tpu.parallel.mesh import MeshSpec, make_mesh
+
+            mesh = make_mesh(MeshSpec(**self.mesh))
+        return TpuIndexV2(self, mesh=mesh)
+
+
+class TpuIndexV2:
+    def __init__(self, config: TpuIndexV2Config, mesh=None) -> None:
+        self.config = config
+        self.mesh = mesh
+        self.dataset = _load_embeddings_dataset(config.dataset_dir)
+        index_dir = config.index_dir or (Path(config.dataset_dir) / 'tpu_index')
+        self._index_file = Path(index_dir) / f'index_{config.precision}.npz'
+        self._build_or_load()
+
+    # ------------------------------------------------------------ building
+    def _build_or_load(self) -> None:
+        if self._index_file.exists():
+            data = np.load(self._index_file)
+            embeddings = data['embeddings']
+        else:
+            embeddings = np.asarray(
+                self.dataset['embeddings'], dtype=np.float32
+            )
+            if self.config.normalize:
+                norms = np.linalg.norm(embeddings, axis=1, keepdims=True)
+                embeddings = embeddings / np.clip(norms, 1e-12, None)
+            if self.config.precision == 'ubinary':
+                embeddings_store = pack_sign_bits(embeddings)
+            else:
+                embeddings_store = embeddings
+            self._index_file.parent.mkdir(parents=True, exist_ok=True)
+            np.savez_compressed(self._index_file, embeddings=embeddings_store)
+            embeddings = embeddings_store
+        self._num_real = embeddings.shape[0]
+        if self.config.precision == 'ubinary':
+            self._packed = jnp.asarray(embeddings)
+            # fp32 copy for rescoring candidates (host-side gather).
+            self._rescore_host = np.asarray(
+                self.dataset['embeddings'], dtype=np.float32
+            )
+            if self.config.normalize:
+                norms = np.linalg.norm(self._rescore_host, axis=1, keepdims=True)
+                self._rescore_host /= np.clip(norms, 1e-12, None)
+            self._corpus = None
+        else:
+            if self.mesh is not None and self.mesh.shape.get('data', 1) > 1:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                shards = self.mesh.shape['data']
+                pad = (-embeddings.shape[0]) % shards
+                if pad:
+                    # Zero rows pad to a shardable row count; their indices
+                    # (>= _num_real) are dropped in the search filter.
+                    embeddings = np.concatenate(
+                        [embeddings, np.zeros((pad, embeddings.shape[1]), embeddings.dtype)]
+                    )
+                self._corpus = jax.device_put(
+                    embeddings, NamedSharding(self.mesh, P('data', None))
+                )
+            else:
+                self._corpus = jnp.asarray(embeddings)
+            self._packed = None
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    # ------------------------------------------------------------- search
+    def search(
+        self,
+        query_embeddings: np.ndarray,  # [B, H] fp32 (normalized by Retriever)
+        top_k: int = 1,
+        score_threshold: float = 0.0,
+    ) -> BatchedSearchResults:
+        if self.config.precision == 'ubinary':
+            scores, indices = self._search_ubinary(query_embeddings, top_k)
+        else:
+            scores, indices = topk_inner_product(
+                jnp.asarray(query_embeddings), self._corpus, top_k, self.mesh
+            )
+            scores, indices = np.asarray(scores), np.asarray(indices)
+        # Score-threshold filter (reference ``search.py:338-382``); padding
+        # rows from the sharded layout (index >= corpus size) are dropped.
+        total_indices, total_scores = [], []
+        for row_scores, row_idx in zip(scores, indices):
+            keep = (row_scores >= score_threshold) & (row_idx < self._num_real)
+            total_indices.append([int(i) for i in row_idx[keep]])
+            total_scores.append([float(s) for s in row_scores[keep]])
+        return BatchedSearchResults(total_indices, total_scores)
+
+    def _search_ubinary(self, queries: np.ndarray, top_k: int):
+        query_bits = jnp.asarray(pack_sign_bits(queries))
+        oversample = min(
+            top_k * self.config.rescore_multiplier, len(self.dataset)
+        )
+        _, cand = hamming_topk(query_bits, self._packed, oversample)
+        cand = np.asarray(cand)
+        # fp32 rescore of the binary candidates against the full-precision
+        # query (sentence-transformers rescore semantics).
+        cand_vectors = self._rescore_host[cand]  # [B, oversample, H]
+        rescored = np.einsum('bh,boh->bo', queries.astype(np.float32), cand_vectors)
+        order = np.argsort(-rescored, axis=1)[:, :top_k]
+        indices = np.take_along_axis(cand, order, axis=1)
+        scores = np.take_along_axis(rescored, order, axis=1)
+        return scores, indices
+
+    # ------------------------------------------------------------ row access
+    def get(self, indices: list[int], key: str) -> list[Any]:
+        """Row field access (reference ``search.py:384-399``)."""
+        rows = self.dataset[indices]
+        return list(rows[key])
+
+
+class TpuIndexV1Config(BaseConfig):
+    """Deprecated V1 surface (reference ``search.py:402-666``)."""
+
+    name: Literal['tpu_index_v1', 'faiss_index_v1'] = 'tpu_index_v1'
+    dataset_dir: Path
+    metric: Literal['inner_product', 'l2'] = 'inner_product'
+
+    def get_index(self) -> 'TpuIndexV2':
+        warnings.warn(
+            'TpuIndexV1 is deprecated; use TpuIndexV2.',
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        v2 = TpuIndexV2Config(dataset_dir=self.dataset_dir)
+        return TpuIndexV2(v2)
+
+
+class RetrieverConfig(BaseConfig):
+    """Parity with ``RetrieverConfig.get_retriever`` (``search.py:669-712``)."""
+
+    faiss_config: dict[str, Any]
+    encoder_config: dict[str, Any]
+    pooler_config: dict[str, Any]
+    batch_size: int = 8
+
+    def get_retriever(self, register: bool = False) -> 'Retriever':
+        from distllm_tpu.embed import get_encoder, get_pooler
+
+        index_config = dict(self.faiss_config)
+        index_config.pop('name', None)
+        index = TpuIndexV2Config(**index_config).get_index()
+        encoder = get_encoder(self.encoder_config, register=register)
+        pooler = get_pooler(self.pooler_config)
+        return Retriever(index, encoder, pooler, self.batch_size)
+
+
+class Retriever:
+    """Query encoding + index search (reference ``search.py:715-928``)."""
+
+    def __init__(
+        self,
+        index: TpuIndexV2,
+        encoder: Encoder,
+        pooler: Pooler,
+        batch_size: int = 8,
+    ) -> None:
+        self.index = index
+        self.encoder = encoder
+        self.pooler = pooler
+        self.batch_size = batch_size
+
+    def get_pooled_embeddings(self, queries: list[str]) -> np.ndarray:
+        """Sort-by-length → batch → encode → pool → normalize → restore order."""
+        from distllm_tpu.embed.embedders.full_sequence import compute_embeddings
+
+        embeddings = compute_embeddings(
+            queries, self.encoder, self.pooler, self.batch_size, normalize=False
+        )
+        norms = np.linalg.norm(embeddings, axis=1, keepdims=True)
+        return embeddings / np.clip(norms, 1e-12, None)
+
+    def search(
+        self,
+        query: str | list[str],
+        top_k: int = 1,
+        score_threshold: float = 0.0,
+    ) -> tuple[BatchedSearchResults, np.ndarray]:
+        """Returns (results, query_embeddings) — reference ``search.py:743-798``."""
+        queries = [query] if isinstance(query, str) else list(query)
+        embeddings = self.get_pooled_embeddings(queries)
+        return self.index.search(embeddings, top_k, score_threshold), embeddings
+
+    def get(self, indices: list[int], key: str) -> list[Any]:
+        return self.index.get(indices, key)
+
+    def get_texts(self, indices: list[int]) -> list[str]:
+        """Parity with ``Retriever.get_texts`` (``search.py:915-928``)."""
+        return self.index.get(indices, 'text')

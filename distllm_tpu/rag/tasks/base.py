@@ -1,0 +1,93 @@
+"""Evaluation task interfaces (reference: ``distllm/rag/tasks/base.py``).
+
+``QuestionAnswerTask`` drives: download (curl, skipped when cached) →
+load_data → RagGenerator.generate with the ``question_answer`` template →
+accuracy + precision, where precision excludes abstentions
+('I cannot answer.', reference ``base.py:108-131``).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from pathlib import Path
+from typing import Any, Protocol, runtime_checkable
+
+from distllm_tpu.generate.prompts import get_prompt_template
+from distllm_tpu.rag.response_synthesizer import RagGenerator
+
+ABSTAIN_ANSWER = 'I cannot answer.'
+
+
+def _normalize_answer(text: str) -> str:
+    """Match the question_answer postprocess normalization (trailing-period
+    strip + lowercase) so abstentions are recognized after postprocessing."""
+    text = text.strip()
+    if text.endswith('.'):
+        text = text[:-1]
+    return text.lower()
+
+
+_ABSTAIN_NORMALIZED = _normalize_answer(ABSTAIN_ANSWER)
+
+
+@runtime_checkable
+class EvaluationTask(Protocol):
+    task_name: str
+
+    def __init__(self, download_dir: Path) -> None: ...
+
+    def evaluate(self, generator: RagGenerator) -> dict[str, Any]: ...
+
+
+class QuestionAnswerTask(ABC):
+    task_name = ''
+
+    def __init__(self, download_dir: Path) -> None:
+        if not self.task_name:
+            raise NotImplementedError('task_name must be set in the subclass.')
+        self.prompt_template = get_prompt_template({'name': 'question_answer'})
+        self.download_dir = Path(download_dir) / self.task_name
+        self.download_dir.mkdir(parents=True, exist_ok=True)
+        self.data_file: Path | None = None
+
+    @abstractmethod
+    def download(self) -> None:
+        """Fetch the dataset (no-op when the file is already on disk)."""
+
+    @abstractmethod
+    def load_data(self) -> tuple[list[str], list[str]]:
+        """Return (questions, ground_truth_answers)."""
+
+    @staticmethod
+    def compute_accuracy(ground_truths: list[str], preds: list[str]) -> float:
+        if not ground_truths:
+            return 0.0
+        correct = sum(g == p for g, p in zip(ground_truths, preds))
+        return correct / len(ground_truths)
+
+    def compute_precision(
+        self, ground_truths: list[str], preds: list[str]
+    ) -> float:
+        """Accuracy over the subset where the model did not abstain.
+
+        Deliberate fix over the reference (``base.py:108-131``): the
+        reference zips the FULL ground-truth list against the filtered
+        predictions, misaligning every pair after the first abstention and
+        dividing by the unfiltered count; here pairs stay aligned and the
+        denominator is the answered subset.
+        """
+        kept = [
+            (g, p)
+            for g, p in zip(ground_truths, preds)
+            if _normalize_answer(p) != _ABSTAIN_NORMALIZED
+        ]
+        return self.compute_accuracy([g for g, _ in kept], [p for _, p in kept])
+
+    def evaluate(self, generator: RagGenerator) -> dict[str, float]:
+        self.download()
+        questions, ground_truths = self.load_data()
+        preds = generator.generate(questions, self.prompt_template)
+        return {
+            'accuracy': self.compute_accuracy(ground_truths, preds),
+            'precision': self.compute_precision(ground_truths, preds),
+        }

@@ -1,0 +1,74 @@
+"""LitQA evaluation task (reference: ``distllm/rag/tasks/litqa.py:44-110``)."""
+
+from __future__ import annotations
+
+import json
+import random
+
+from pydantic import BaseModel, Field, field_validator
+
+from distllm_tpu.rag.tasks.base import QuestionAnswerTask
+from distllm_tpu.utils import curl_download
+
+LITQA_URL = (
+    'https://raw.githubusercontent.com/Future-House/LitQA/main/litqa-v0.jsonl'
+)
+
+
+class QuestionAnswerEntry(BaseModel):
+    id: str = Field(default='')
+    question: str
+    ideal: str
+    distractors: list[str]
+    sources: str | list[str] = Field(default='')
+
+    @field_validator('ideal', mode='before')
+    @classmethod
+    def _lower_ideal(cls, value: str) -> str:
+        return value.lower()
+
+    @field_validator('distractors', mode='before')
+    @classmethod
+    def _lower_distractors(cls, value: list[str]) -> list[str]:
+        return [v.lower() for v in value]
+
+    def get_multiple_choice(self, rng: random.Random | None = None) -> str:
+        """Random 3 distractors (padded with '' when fewer) + shuffle.
+
+        Sampling/shuffling uses an RNG seeded per entry (question hash) so
+        every model in an eval suite is graded on the SAME rendering and runs
+        are reproducible — the reference's unseeded global ``random`` makes
+        accuracy partly an RNG artifact across models.
+        """
+        if rng is None:
+            seed = int.from_bytes(
+                __import__('hashlib').sha256(self.question.encode()).digest()[:8],
+                'little',
+            )
+            rng = random.Random(seed)
+        k = 3
+        distractors = rng.sample(
+            self.distractors, min(k, len(self.distractors))
+        )
+        distractors.extend([''] * (k - len(distractors)))
+        options = [self.ideal, *distractors]
+        rng.shuffle(options)
+        mark = '' if self.question.endswith('?') else '?'
+        return '{}\nOptions:\n1. {}\n2. {}\n3. {}\n4. {}\n'.format(
+            f'{self.question}{mark}', *options
+        )
+
+
+class LitQATask(QuestionAnswerTask):
+    task_name = 'litqa'
+
+    def download(self) -> None:
+        self.data_file = self.download_dir / 'litqa.jsonl'
+        curl_download(LITQA_URL, self.data_file)
+
+    def load_data(self) -> tuple[list[str], list[str]]:
+        lines = self.data_file.read_text().strip().split('\n')
+        entries = [QuestionAnswerEntry(**json.loads(line)) for line in lines]
+        questions = [e.get_multiple_choice() for e in entries]
+        ground_truths = [e.ideal for e in entries]
+        return questions, ground_truths

@@ -1,0 +1,68 @@
+"""AmpQA protein tasks (reference: ``rag/tasks/protein_function_qa.py`` and
+``rag/tasks/protein_interaction_qa.py``).
+
+Both filter out entries whose ideal answer exceeds 200 words and build
+shuffled 4-option multiple-choice questions like LitQA.
+"""
+
+from __future__ import annotations
+
+import json
+
+from distllm_tpu.rag.tasks.base import QuestionAnswerTask
+from distllm_tpu.rag.tasks.litqa import QuestionAnswerEntry
+from distllm_tpu.utils import curl_download
+
+FUNCTION_QA_URL = (
+    'https://raw.githubusercontent.com/ramanathanlab/AmpQA/main/FunctionQA.jsonl'
+)
+INTERACTION_QA_URL = (
+    'https://raw.githubusercontent.com/ramanathanlab/AmpQA/main/interactionQA.json'
+)
+
+_MAX_IDEAL_WORDS = 200
+
+
+def _filter_long_ideals(
+    entries: list[QuestionAnswerEntry],
+) -> list[QuestionAnswerEntry]:
+    return [
+        e for e in entries if len(e.ideal.split()) <= _MAX_IDEAL_WORDS
+    ]
+
+
+def _to_questions(
+    entries: list[QuestionAnswerEntry],
+) -> tuple[list[str], list[str]]:
+    entries = _filter_long_ideals(entries)
+    return (
+        [e.get_multiple_choice() for e in entries],
+        [e.ideal for e in entries],
+    )
+
+
+class ProteinFunctionQATask(QuestionAnswerTask):
+    task_name = 'protein_function_qa'
+
+    def download(self) -> None:
+        self.data_file = self.download_dir / 'functionQA.jsonl'
+        curl_download(FUNCTION_QA_URL, self.data_file)
+
+    def load_data(self) -> tuple[list[str], list[str]]:
+        lines = self.data_file.read_text().strip().split('\n')
+        entries = [QuestionAnswerEntry(**json.loads(line)) for line in lines]
+        return _to_questions(entries)
+
+
+class ProteinInteractionQATask(QuestionAnswerTask):
+    task_name = 'protein_interaction_qa'
+
+    def download(self) -> None:
+        self.data_file = self.download_dir / 'interactionQA.json'
+        curl_download(INTERACTION_QA_URL, self.data_file)
+
+    def load_data(self) -> tuple[list[str], list[str]]:
+        with open(self.data_file) as fh:
+            data = json.load(fh)
+        entries = [QuestionAnswerEntry(**entry) for entry in data]
+        return _to_questions(entries)

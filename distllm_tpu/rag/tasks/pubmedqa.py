@@ -1,0 +1,47 @@
+"""PubMedQA evaluation task (reference: ``distllm/rag/tasks/pubmedqa.py``)."""
+
+from __future__ import annotations
+
+import json
+
+from pydantic import BaseModel, Field
+
+from distllm_tpu.rag.tasks.base import QuestionAnswerTask
+from distllm_tpu.utils import curl_download
+
+PUBMEDQA_URL = (
+    'https://raw.githubusercontent.com/pubmedqa/pubmedqa/master/data/ori_pqal.json'
+)
+
+
+class PubmedQAEntry(BaseModel):
+    QUESTION: str
+    CONTEXTS: list[str]
+    final_decision: str = Field(description='yes / no / maybe')
+
+    model_config = {'extra': 'ignore'}
+
+    def get_multiple_choice(self) -> str:
+        """yes/no/maybe options with the PubmedQA-provided contexts inline."""
+        mark = '' if self.QUESTION.endswith('?') else '?'
+        options = ['yes', 'no', 'maybe']
+        joined = '\n'.join(self.CONTEXTS)
+        return '{}\n{}\n{}\nOptions:\n1. {}\n2. {}\n3. {}\n'.format(
+            'Most relevant context:', joined, f'{self.QUESTION}{mark}', *options
+        )
+
+
+class PubmedQATask(QuestionAnswerTask):
+    task_name = 'pubmedqa'
+
+    def download(self) -> None:
+        self.data_file = self.download_dir / 'pubmedQA.json'
+        curl_download(PUBMEDQA_URL, self.data_file)
+
+    def load_data(self) -> tuple[list[str], list[str]]:
+        with open(self.data_file) as fh:
+            data = json.load(fh)
+        entries = [PubmedQAEntry(**value) for value in data.values()]
+        questions = [e.get_multiple_choice() for e in entries]
+        ground_truths = [e.final_decision for e in entries]
+        return questions, ground_truths

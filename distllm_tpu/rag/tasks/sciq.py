@@ -1,0 +1,59 @@
+"""SciQ evaluation task (reference: ``distllm/rag/tasks/sciq.py:35-110``).
+
+Deliberate fixes over the reference: the reference's format string drops the
+fourth option ('1..2..3.' placeholders for 4 options) and compares lowercased
+predictions against unlowered ground truths; here all four options render and
+ground truths are lowercased to match the question_answer postprocess.
+"""
+
+from __future__ import annotations
+
+import json
+
+from pydantic import BaseModel, Field
+
+from distllm_tpu.rag.tasks.base import QuestionAnswerTask
+from distllm_tpu.utils import curl_download
+
+SCIQ_URL = (
+    'https://raw.githubusercontent.com/ogkdmr/sciqa_questions/main/test.json'
+)
+
+
+class SciQEntry(BaseModel):
+    question: str
+    distractor1: str
+    distractor2: str
+    distractor3: str
+    correct_answer: str
+    support: str = Field(default='')
+
+    model_config = {'extra': 'ignore'}
+
+    def get_multiple_choice(self) -> str:
+        mark = '' if self.question.endswith('?') else '?'
+        options = [
+            self.correct_answer,
+            self.distractor1,
+            self.distractor2,
+            self.distractor3,
+        ]
+        return '{}\nOptions:\n1. {}\n2. {}\n3. {}\n4. {}\n'.format(
+            f'{self.question}{mark}', *options
+        )
+
+
+class SciQTask(QuestionAnswerTask):
+    task_name = 'sciq'
+
+    def download(self) -> None:
+        self.data_file = self.download_dir / 'sciq.json'
+        curl_download(SCIQ_URL, self.data_file)
+
+    def load_data(self) -> tuple[list[str], list[str]]:
+        with open(self.data_file) as fh:
+            data = json.load(fh)
+        entries = [SciQEntry(**entry) for entry in data]
+        questions = [e.get_multiple_choice() for e in entries]
+        ground_truths = [e.correct_answer.lower() for e in entries]
+        return questions, ground_truths

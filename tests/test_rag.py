@@ -1,0 +1,176 @@
+"""RAG layer tests: response synthesizer, QA tasks, eval suite."""
+
+import json
+
+import numpy as np
+import pytest
+
+from distllm_tpu.generate import get_generator
+from distllm_tpu.rag.response_synthesizer import RagGenerator
+from distllm_tpu.rag.tasks import TASKS, get_task
+from distllm_tpu.rag.tasks.litqa import LitQATask, QuestionAnswerEntry
+from distllm_tpu.rag.tasks.pubmedqa import PubmedQAEntry
+from distllm_tpu.rag.tasks.sciq import SciQEntry
+
+
+def _make_retriever(tmp_path):
+    from datasets import Dataset
+
+    from distllm_tpu.embed import get_encoder, get_pooler
+    from distllm_tpu.embed.embedders.full_sequence import compute_embeddings
+    from distllm_tpu.rag.search import RetrieverConfig
+
+    encoder = get_encoder({'name': 'fake', 'embedding_size': 32})
+    pooler = get_pooler({'name': 'mean'})
+    texts = ['context about proteins', 'context about stars', 'context about cells']
+    embeddings = compute_embeddings(texts, encoder, pooler, 2)
+    Dataset.from_dict(
+        {'text': texts, 'embeddings': [e for e in embeddings]}
+    ).save_to_disk(str(tmp_path / 'corpus'))
+    return RetrieverConfig(
+        faiss_config={'dataset_dir': str(tmp_path / 'corpus')},
+        encoder_config={'name': 'fake', 'embedding_size': 32},
+        pooler_config={'name': 'mean'},
+        batch_size=2,
+    ).get_retriever()
+
+
+def test_rag_generator_no_retriever():
+    generator = RagGenerator(get_generator({'name': 'fake'}))
+    out = generator.generate('what is a protein')
+    assert out == ['response to: what is a protein']
+
+
+def test_rag_generator_with_retrieval(tmp_path):
+    retriever = _make_retriever(tmp_path)
+    echo = get_generator(
+        {'name': 'fake', 'response_template': '{prompt}', 'max_prompt_chars': 4000}
+    )
+    generator = RagGenerator(echo, retriever=retriever)
+    from distllm_tpu.generate import get_prompt_template
+
+    out = generator.generate(
+        'context about proteins',
+        prompt_template=get_prompt_template({'name': 'question_answer'}),
+        retrieval_top_k=2,
+        retrieval_score_threshold=-10.0,
+    )
+    # The echoed prompt should contain retrieved context lines with scores.
+    assert 'context (with relevance scores)' in out[0]
+    assert 'score:' in out[0]
+
+
+# ------------------------------------------------------------------ tasks
+def test_task_registry():
+    assert set(TASKS) == {
+        'litqa',
+        'pubmedqa',
+        'sciq',
+        'protein_function_qa',
+        'protein_interaction_qa',
+    }
+    with pytest.raises(ValueError):
+        get_task('bogus', '/tmp')
+
+
+def test_litqa_entry_multiple_choice():
+    entry = QuestionAnswerEntry(
+        question='What binds DNA',
+        ideal='Histones',
+        distractors=['Lipids', 'Sugars', 'Ions', 'Metals'],
+    )
+    assert entry.ideal == 'histones'  # lowercased by validator
+    mc = entry.get_multiple_choice()
+    assert mc.startswith('What binds DNA?\nOptions:\n1. ')
+    assert 'histones' in mc
+    assert mc.count('\n') >= 5
+
+
+def test_litqa_entry_pads_missing_distractors():
+    entry = QuestionAnswerEntry(question='Q?', ideal='A', distractors=['b'])
+    mc = entry.get_multiple_choice()
+    assert '4. ' in mc  # still four options
+
+
+def test_pubmedqa_entry():
+    entry = PubmedQAEntry(
+        QUESTION='Does X work',
+        CONTEXTS=['ctx1', 'ctx2'],
+        final_decision='yes',
+        LONG_ANSWER='ignored extra field',
+    )
+    mc = entry.get_multiple_choice()
+    assert 'Most relevant context:' in mc
+    assert 'ctx1\nctx2' in mc
+    assert '1. yes\n2. no\n3. maybe' in mc
+
+
+def test_sciq_entry_has_four_options():
+    entry = SciQEntry(
+        question='Which gas',
+        distractor1='helium',
+        distractor2='argon',
+        distractor3='neon',
+        correct_answer='oxygen',
+    )
+    mc = entry.get_multiple_choice()
+    for option in ('oxygen', 'helium', 'argon', 'neon'):
+        assert option in mc
+
+
+def test_task_accuracy_precision(tmp_path):
+    task = LitQATask.__new__(LitQATask)  # skip download plumbing
+    assert task.compute_accuracy(['a', 'b'], ['a', 'c']) == 0.5
+    precision = task.compute_precision(
+        ['a', 'b', 'c'], ['a', 'i cannot answer.', 'c']
+    )
+    assert precision == 1.0  # abstention dropped; note: pairs stay aligned
+
+
+def test_task_end_to_end_with_local_data(tmp_path, monkeypatch):
+    """Full task.evaluate with a fake generator and a local litqa file."""
+    data = [
+        {
+            'question': 'What is water',
+            'ideal': 'H2O',
+            'distractors': ['CO2', 'NaCl', 'O2'],
+        }
+    ]
+    litqa_dir = tmp_path / 'litqa'
+    litqa_dir.mkdir(parents=True)
+    (litqa_dir / 'litqa.jsonl').write_text(
+        '\n'.join(json.dumps(d) for d in data)
+    )
+    task = get_task('litqa', tmp_path)  # file exists -> download skipped
+    generator = RagGenerator(
+        get_generator({'name': 'fake', 'response_template': 'h2o'})
+    )
+    results = task.evaluate(generator)
+    assert results == {'accuracy': 1.0, 'precision': 1.0}
+
+
+def test_eval_suite(tmp_path):
+    from distllm_tpu.rag.evaluate import EvalSuiteConfig, run_eval_suite
+    from distllm_tpu.registry import registry
+
+    litqa_dir = tmp_path / 'dl' / 'litqa'
+    litqa_dir.mkdir(parents=True)
+    (litqa_dir / 'litqa.jsonl').write_text(
+        json.dumps(
+            {'question': 'Q', 'ideal': 'x', 'distractors': ['y', 'z', 'w']}
+        )
+    )
+    config = EvalSuiteConfig(
+        rag_configs=[
+            {
+                'generator_config': {'name': 'fake', 'response_template': 'x'}
+            }
+        ],
+        tasks=['litqa'],
+        download_dir=tmp_path / 'dl',
+        output_path=tmp_path / 'results.json',
+    )
+    results = run_eval_suite(config)
+    assert results['model_0']['litqa']['accuracy'] == 1.0
+    assert (tmp_path / 'results.json').exists()
+    registry().clear()
