@@ -18,6 +18,10 @@ from pydantic import Field
 from distllm_tpu.utils import BaseConfig, expo_backoff_retry
 
 
+class ApiAuthError(Exception):
+    """HTTP 401/403 from the endpoint — retrying cannot help."""
+
+
 class ApiGeneratorConfig(BaseConfig):
     name: Literal['api', 'langchain'] = 'api'
     openai_api_base: str = 'https://api.openai.com/v1'
@@ -32,6 +36,12 @@ class ApiGeneratorConfig(BaseConfig):
     max_tokens: int = 512
     timeout: float = 120.0
     max_tries: int = 5
+    concurrency: int = Field(
+        default=8,
+        description='Parallel HTTP requests per generate() batch — lets an '
+        "OpenAI-compatible server's continuous batching see the whole batch "
+        'at once.',
+    )
     extra_body: dict = Field(
         default_factory=dict,
         description='Extra JSON merged into each request (e.g. Argo-proxy '
@@ -66,12 +76,27 @@ class ApiGenerator:
                 headers=headers,
                 timeout=self.config.timeout,
             )
+            if response.status_code in (401, 403):
+                raise ApiAuthError(
+                    f'{response.status_code} from {self.config.openai_api_base}'
+                )
             response.raise_for_status()
             return response.json()['choices'][0]['message']['content']
 
-        return expo_backoff_retry(call, max_tries=self.config.max_tries)
+        return expo_backoff_retry(
+            call, max_tries=self.config.max_tries, give_up_on=(ApiAuthError,)
+        )
 
     def generate(self, prompts: str | list[str]) -> list[str]:
         if isinstance(prompts, str):
             prompts = [prompts]
-        return [self._chat(p) for p in prompts]
+        if len(prompts) == 1 or self.config.concurrency <= 1:
+            return [self._chat(p) for p in prompts]
+        # Concurrent requests: an OpenAI-compatible server with continuous
+        # batching schedules them together (one-at-a-time would serialize).
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(self.config.concurrency, len(prompts))
+        ) as pool:
+            return list(pool.map(self._chat, prompts))

@@ -1,0 +1,20 @@
+"""MCQA evaluation harness.
+
+TPU-native re-design of the reference's self-contained MCQA pipelines
+(``distllm/mcqa/rag_argonium_score_parallel_{v2,v3}.py``, ~3.8k LoC): a model
+answers multiple-choice questions (RAG-augmented or direct) and a second LLM
+grades the answers. Feature parity targets (SURVEY.md section 2.3):
+
+- local engine-server boot with auto port + monitor threads + readiness poll
+- client-side request batching (queue + batch thread)
+- thread-pool parallelism over questions
+- checkpoint/resume with compatibility validation (+ per-question mode)
+- grader JSON retry ladder (3 escalating prompts) with expo backoff
+- chunk-ID traceability and retrieval metrics
+- accuracy stats + incorrect-answer export, signal-handler cleanup
+"""
+
+from distllm_tpu.mcqa.config import MCQAConfig, ModelServerEntry
+from distllm_tpu.mcqa.harness import main, run_mcqa
+
+__all__ = ['MCQAConfig', 'ModelServerEntry', 'main', 'run_mcqa']

@@ -1,0 +1,107 @@
+"""Client-side request batching for the MCQA harness.
+
+Reference parity: ``rag_argonium_score_parallel_v3.py:1407-1605`` — worker
+threads enqueue single requests; a background batch thread collects up to
+``batch_size`` requests (or whatever arrived within ``batch_timeout``
+seconds) and ships them to the OpenAI-compatible endpoint together, feeding
+the server's continuous-batching engine properly instead of dribbling one
+request per HTTP call.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class _Pending:
+    prompt: str
+    event: threading.Event = field(default_factory=threading.Event)
+    result: str | None = None
+    error: Exception | None = None
+    abandoned: bool = False
+
+
+class BatchingClient:
+    """Queue + condition-variable batcher in front of a generate function.
+
+    ``send_batch(prompts) -> responses`` is the transport (HTTP client or
+    in-process generator); callers use :meth:`generate` from any thread.
+    """
+
+    def __init__(
+        self,
+        send_batch: Callable[[list[str]], list[str]],
+        batch_size: int = 16,
+        batch_timeout: float = 0.5,
+    ) -> None:
+        self._send_batch = send_batch
+        self.batch_size = batch_size
+        self.batch_timeout = batch_timeout
+        self._queue: list[_Pending] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self.batches_sent = 0
+        self.requests_sent = 0
+
+    def generate(self, prompt: str, timeout: float | None = None) -> str:
+        pending = _Pending(prompt)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError('BatchingClient is closed')
+            self._queue.append(pending)
+            self._cond.notify()
+        if not pending.event.wait(timeout):
+            # Drop the stale entry so a retry doesn't duplicate load on an
+            # already-slow backend (if still queued, remove; if in flight,
+            # mark so its late result is discarded).
+            with self._cond:
+                pending.abandoned = True
+                if pending in self._queue:
+                    self._queue.remove(pending)
+            raise TimeoutError('batched request timed out')
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._queue:
+                    return
+                # Collect until full or quiet for batch_timeout.
+                deadline_passed = False
+                while (
+                    len(self._queue) < self.batch_size and not deadline_passed
+                ):
+                    before = len(self._queue)
+                    self._cond.wait(timeout=self.batch_timeout)
+                    deadline_passed = len(self._queue) == before
+                batch = [
+                    p for p in self._queue[: self.batch_size] if not p.abandoned
+                ]
+                del self._queue[: self.batch_size]
+                if not batch:
+                    continue
+            try:
+                responses = self._send_batch([p.prompt for p in batch])
+                for pending, response in zip(batch, responses):
+                    pending.result = response
+                    pending.event.set()
+            except Exception as exc:  # noqa: BLE001 - delivered to callers
+                for pending in batch:
+                    pending.error = exc
+                    pending.event.set()
+            self.batches_sent += 1
+            self.requests_sent += len(batch)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

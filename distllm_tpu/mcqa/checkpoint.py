@@ -1,0 +1,108 @@
+"""MCQA checkpoint/resume.
+
+Reference parity: ``rag_argonium_score_parallel_v3.py:2891-3073`` — JSON
+checkpoints ``{timestamp, completed_indices, results, metadata, config,
+version}`` saved every N questions (or per question in ultra-safe mode),
+auto-resume from the latest compatible checkpoint (model + questions-file
+validation), thread-safe progress updates.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+CHECKPOINT_VERSION = 1
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        checkpoint_dir: str | Path,
+        metadata: dict[str, Any],
+        every: int = 10,
+        save_incremental: bool = False,
+    ) -> None:
+        self.checkpoint_dir = Path(checkpoint_dir)
+        self.checkpoint_dir.mkdir(parents=True, exist_ok=True)
+        self.metadata = metadata
+        self.every = max(1, every)
+        self.save_incremental = save_incremental
+        self._lock = threading.Lock()
+        self.results: dict[int, dict[str, Any]] = {}
+        self._since_save = 0
+
+    # ---------------------------------------------------------------- save
+    def record(self, index: int, result: dict[str, Any]) -> None:
+        """Thread-safe progress update with periodic checkpointing
+        (``update_progress_with_checkpointing``, ``v3:3459-3511``)."""
+        with self._lock:
+            self.results[index] = result
+            self._since_save += 1
+            if self.save_incremental or self._since_save >= self.every:
+                self._save_locked()
+                self._since_save = 0
+
+    def save(self) -> Path:
+        with self._lock:
+            return self._save_locked()
+
+    def _save_locked(self) -> Path:
+        payload = {
+            'version': CHECKPOINT_VERSION,
+            'timestamp': time.time(),
+            'completed_indices': sorted(self.results),
+            'results': {str(k): v for k, v in self.results.items()},
+            'metadata': self.metadata,
+        }
+        path = self.checkpoint_dir / f'checkpoint_{int(time.time()*1000)}.json'
+        tmp = path.with_suffix('.tmp')
+        tmp.write_text(json.dumps(payload))
+        tmp.rename(path)
+        # Keep only the 3 newest checkpoints.
+        checkpoints = sorted(self.checkpoint_dir.glob('checkpoint_*.json'))
+        for old in checkpoints[:-3]:
+            old.unlink(missing_ok=True)
+        return path
+
+    # --------------------------------------------------------------- resume
+    @staticmethod
+    def find_latest(checkpoint_dir: str | Path) -> Path | None:
+        checkpoints = sorted(Path(checkpoint_dir).glob('checkpoint_*.json'))
+        return checkpoints[-1] if checkpoints else None
+
+    def try_resume(self) -> int:
+        """Load the latest compatible checkpoint; returns #completed."""
+        latest = self.find_latest(self.checkpoint_dir)
+        if latest is None:
+            return 0
+        try:
+            payload = json.loads(latest.read_text())
+        except json.JSONDecodeError:
+            print(f'[checkpoint] ignoring corrupt {latest}')
+            return 0
+        if payload.get('version') != CHECKPOINT_VERSION:
+            print(f'[checkpoint] version mismatch in {latest}; ignoring')
+            return 0
+        meta = payload.get('metadata', {})
+        for key in ('model', 'questions_file'):
+            if key in self.metadata and meta.get(key) != self.metadata[key]:
+                print(
+                    f'[checkpoint] {key} mismatch '
+                    f'({meta.get(key)!r} != {self.metadata[key]!r}); ignoring'
+                )
+                return 0
+        with self._lock:
+            self.results = {
+                int(k): v for k, v in payload.get('results', {}).items()
+            }
+        print(f'[checkpoint] resumed {len(self.results)} results from {latest.name}')
+        return len(self.results)
+
+    @property
+    def completed_indices(self) -> set[int]:
+        with self._lock:
+            return set(self.results)

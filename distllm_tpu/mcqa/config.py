@@ -1,0 +1,110 @@
+"""MCQA harness configuration.
+
+Reference parity: ``MCQAConfig`` (``rag_argonium_score_parallel_v3.py:401-445``)
+and the ``model_servers.yaml`` shortname registry (``v3:716-751``).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import yaml
+from pydantic import Field
+
+from distllm_tpu.utils import BaseConfig
+
+
+class ModelServerEntry(BaseConfig):
+    """One row of the model-servers registry."""
+
+    server: str = ''
+    shortname: str
+    openai_api_key: str = ''
+    openai_api_base: str = ''
+    openai_model: str = ''
+
+
+def load_model_servers(path: str | Path) -> dict[str, ModelServerEntry]:
+    """Read a ``model_servers.yaml`` into a shortname-keyed registry."""
+    with open(path) as fh:
+        raw = yaml.safe_load(fh) or {}
+    entries = raw.get('servers', raw) if isinstance(raw, dict) else raw
+    if isinstance(entries, dict):
+        entries = list(entries.values())
+    registry = {}
+    for item in entries:
+        entry = ModelServerEntry(**item)
+        registry[entry.shortname] = entry
+    return registry
+
+
+class VllmArgs(BaseConfig):
+    """Engine knobs for the locally booted server (vLLM-arg parity)."""
+
+    tensor_parallel_size: int = 1
+    max_model_len: int = 4096
+    max_num_seqs: int = 16
+    block_size: int = 16
+    num_blocks: int = 2048
+
+
+class MCQAConfig(BaseConfig):
+    questions_file: Path
+    output_dir: Path = Path('mcqa_results')
+
+    # Model under test: either a registry shortname, an explicit endpoint,
+    # or a local checkpoint to boot a server for.
+    model_servers_file: Path | None = None
+    model_shortname: str = ''
+    model_api_base: str = ''
+    model_api_key: str = ''
+    model_name: str = 'distllm-tpu'
+    local_model_path: str = ''  # non-empty => boot a local engine server
+    vllm_args: VllmArgs = VllmArgs()
+
+    # Grader LLM.
+    grader_shortname: str = ''
+    grader_api_base: str = ''
+    grader_api_key: str = ''
+    grader_model: str = ''
+    grader_max_new_tokens: int = 64
+    grader_temperature: float = 0.0
+
+    # RAG (optional).
+    retriever_config: dict[str, Any] | None = None
+    retrieval_top_k: int = 5
+    retrieval_score_threshold: float = 0.0
+
+    # Parallelism + client batching.
+    parallel_workers: int = 8
+    batch_size: int = 16
+    batch_timeout: float = 0.5
+    request_temperature: float = 0.0
+    request_max_tokens: int = 256
+
+    # Checkpointing.
+    checkpoint_every: int = Field(
+        default=10, description='Save a checkpoint every N questions.'
+    )
+    save_incremental: bool = Field(
+        default=False, description='Ultra-safe per-question checkpointing.'
+    )
+    resume: bool = True
+
+    def resolve_model_endpoint(self) -> tuple[str, str, str]:
+        """Returns (api_base, api_key, model) for the model under test."""
+        if self.model_shortname and self.model_servers_file:
+            entry = load_model_servers(self.model_servers_file)[
+                self.model_shortname
+            ]
+            return entry.openai_api_base, entry.openai_api_key, entry.openai_model
+        return self.model_api_base, self.model_api_key, self.model_name
+
+    def resolve_grader_endpoint(self) -> tuple[str, str, str]:
+        if self.grader_shortname and self.model_servers_file:
+            entry = load_model_servers(self.model_servers_file)[
+                self.grader_shortname
+            ]
+            return entry.openai_api_base, entry.openai_api_key, entry.openai_model
+        return self.grader_api_base, self.grader_api_key, self.grader_model

@@ -1,0 +1,235 @@
+"""MCQA harness tests: batching, grading ladder, checkpointing, pipeline."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from distllm_tpu.mcqa.batching import BatchingClient
+from distllm_tpu.mcqa.checkpoint import CheckpointManager
+from distllm_tpu.mcqa.config import MCQAConfig, load_model_servers
+from distllm_tpu.mcqa.grading import (
+    GraderAuthError,
+    grade_answer,
+    parse_grader_json,
+)
+from distllm_tpu.mcqa.harness import chunk_id, load_questions, run_mcqa
+
+
+# ---------------------------------------------------------------- batching
+def test_batching_client_batches_requests():
+    batches = []
+
+    def send(prompts):
+        batches.append(list(prompts))
+        return [f'r:{p}' for p in prompts]
+
+    client = BatchingClient(send, batch_size=4, batch_timeout=0.2)
+    results = {}
+
+    def worker(i):
+        results[i] = client.generate(f'p{i}', timeout=10)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    client.close()
+    assert {results[i] for i in range(8)} == {f'r:p{i}' for i in range(8)}
+    assert len(batches) <= 4  # requests were actually coalesced
+    assert any(len(b) > 1 for b in batches)
+
+
+def test_batching_client_propagates_errors():
+    def send(prompts):
+        raise ConnectionError('backend down')
+
+    client = BatchingClient(send, batch_size=2, batch_timeout=0.05)
+    with pytest.raises(ConnectionError):
+        client.generate('x', timeout=5)
+    client.close()
+
+
+# ----------------------------------------------------------------- grading
+def test_parse_grader_json():
+    assert parse_grader_json('{"correct": true}')['correct'] is True
+    assert parse_grader_json('blah {"correct": false, "reason": "no"} end')[
+        'reason'
+    ] == 'no'
+    assert parse_grader_json('not json') is None
+    assert parse_grader_json('{"correct": "yes"}') is None  # not boolean
+
+
+def test_grade_answer_ladder_escalates():
+    calls = []
+
+    def grader(prompt):
+        calls.append(prompt)
+        if len(calls) < 2:
+            return 'I think the answer is correct!'  # unparseable
+        return '{"correct": true, "reason": "matches"}'
+
+    verdict = grade_answer(grader, 'Q', 'ref', 'ans', max_tries_per_level=1)
+    assert verdict['correct'] is True
+    assert verdict['ladder_level'] == 1  # escalated once
+    assert 'ONLY a JSON object' in calls[1]
+
+
+def test_grade_answer_auth_gives_up():
+    def grader(prompt):
+        raise GraderAuthError('bad key')
+
+    with pytest.raises(GraderAuthError):
+        grade_answer(grader, 'Q', 'ref', 'ans')
+
+
+def test_grade_answer_all_levels_fail():
+    def grader(prompt):
+        return 'gibberish'
+
+    with pytest.raises(RuntimeError, match='no parseable JSON'):
+        grade_answer(grader, 'Q', 'ref', 'ans', max_tries_per_level=1)
+
+
+# -------------------------------------------------------------- checkpoint
+def test_checkpoint_save_resume(tmp_path):
+    meta = {'model': 'm1', 'questions_file': 'q.json'}
+    ckpt = CheckpointManager(tmp_path, meta, every=2)
+    ckpt.record(0, {'correct': True})
+    ckpt.record(1, {'correct': False})  # triggers save
+    ckpt.record(2, {'correct': True})
+    ckpt.save()
+
+    fresh = CheckpointManager(tmp_path, meta, every=2)
+    assert fresh.try_resume() == 3
+    assert fresh.completed_indices == {0, 1, 2}
+
+
+def test_checkpoint_rejects_mismatched_model(tmp_path):
+    ckpt = CheckpointManager(tmp_path, {'model': 'm1', 'questions_file': 'q'}, every=1)
+    ckpt.record(0, {'correct': True})
+    other = CheckpointManager(tmp_path, {'model': 'OTHER', 'questions_file': 'q'})
+    assert other.try_resume() == 0
+
+
+def test_checkpoint_incremental(tmp_path):
+    ckpt = CheckpointManager(tmp_path, {}, every=100, save_incremental=True)
+    ckpt.record(0, {'correct': True})
+    assert CheckpointManager.find_latest(tmp_path) is not None
+
+
+# ------------------------------------------------------------------ config
+def test_model_servers_registry(tmp_path):
+    f = tmp_path / 'servers.yaml'
+    f.write_text(
+        'servers:\n'
+        '  - shortname: llama\n'
+        '    openai_api_base: http://h1:8000/v1\n'
+        '    openai_model: meta/llama\n'
+        '  - shortname: grader\n'
+        '    openai_api_base: http://h2:8000/v1\n'
+        '    openai_model: gpt-x\n'
+        '    openai_api_key: sk-test\n'
+    )
+    registry = load_model_servers(f)
+    assert registry['llama'].openai_api_base == 'http://h1:8000/v1'
+    assert registry['grader'].openai_api_key == 'sk-test'
+
+
+def test_chunk_id_stable():
+    assert chunk_id('doc.pdf', 3) == chunk_id('doc.pdf', 3)
+    assert chunk_id('doc.pdf', 3) != chunk_id('doc.pdf', 4)
+    assert chunk_id('doc.pdf', 3).endswith('_0003')
+
+
+def test_load_questions(tmp_path):
+    f = tmp_path / 'q.json'
+    f.write_text(json.dumps([{'question': 'Q1?', 'answer': 'A'}]))
+    assert load_questions(f)[0]['question'] == 'Q1?'
+    bad = tmp_path / 'bad.json'
+    bad.write_text(json.dumps([{'question': 'no answer field'}]))
+    with pytest.raises(ValueError):
+        load_questions(bad)
+
+
+# --------------------------------------------------- end-to-end (stub HTTP)
+@pytest.fixture
+def stub_openai_server():
+    """OpenAI-compatible stub: echoes for the model, grades 'correct' when
+    the model answer contains the reference."""
+    import re
+    import socket
+
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers['Content-Length'])
+            body = json.loads(self.rfile.read(length))
+            prompt = body['messages'][0]['content']
+            if 'grading a multiple-choice answer' in prompt or 'Grade the answer' in prompt or 'minified JSON' in prompt:
+                ref = re.search(r'Reference(?: answer)?: (.*)', prompt).group(1).splitlines()[0]
+                ans = re.search(r'(?:Model answer|Answer): (.*)', prompt).group(1).splitlines()[0]
+                verdict = {'correct': ref.strip().lower() in ans.strip().lower()}
+                content = json.dumps(verdict)
+            else:
+                # The model: answer 'paris' to everything.
+                content = 'paris'
+            payload = {
+                'choices': [{'message': {'role': 'assistant', 'content': content}}]
+            }
+            data = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *args):
+            pass
+
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    server = ThreadingHTTPServer(('127.0.0.1', port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f'http://127.0.0.1:{port}/v1'
+    server.shutdown()
+
+
+def test_run_mcqa_end_to_end(tmp_path, stub_openai_server):
+    questions = [
+        {'question': 'Capital of France?\n1. paris\n2. rome', 'answer': 'paris'},
+        {'question': 'Capital of Italy?\n1. paris\n2. rome', 'answer': 'rome'},
+    ]
+    qfile = tmp_path / 'questions.json'
+    qfile.write_text(json.dumps(questions))
+
+    config = MCQAConfig(
+        questions_file=qfile,
+        output_dir=tmp_path / 'out',
+        model_api_base=stub_openai_server,
+        model_name='stub',
+        grader_api_base=stub_openai_server,
+        grader_model='stub-grader',
+        parallel_workers=2,
+        batch_size=2,
+        batch_timeout=0.1,
+        checkpoint_every=1,
+    )
+    summary = run_mcqa(config)
+    assert summary['graded'] == 2
+    assert summary['correct'] == 1  # model always says paris
+    assert summary['accuracy'] == 0.5
+    results = json.loads((tmp_path / 'out' / 'results.json').read_text())
+    assert results['summary']['model'] == 'stub'
+    incorrect = json.loads(
+        (tmp_path / 'out' / 'incorrect_answers.json').read_text()
+    )
+    assert len(incorrect) == 1
+    # Resume: everything already done.
+    summary2 = run_mcqa(config)
+    assert summary2['graded'] == 2
