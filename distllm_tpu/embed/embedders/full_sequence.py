@@ -33,13 +33,29 @@ def compute_embeddings(
     pooler: Pooler,
     batch_size: int,
     normalize: bool = False,
+    flush_every: int = 64,
 ) -> np.ndarray:
-    """Embed ``texts`` → host ``[N, H]`` float32 array in original order."""
+    """Embed ``texts`` → host ``[N, H]`` float32 array in original order.
+
+    Dispatch is asynchronous: each batch's forward+pool is enqueued and the
+    pooled ``[B, H]`` device arrays are collected without blocking, so host
+    tokenization of batch *i+1* overlaps device compute of batch *i*. Results
+    flush to the host buffer every ``flush_every`` batches (bounds retained
+    pooled outputs at ``flush_every * B * H`` floats — ~100 MB at B=512,
+    H=768; lower ``flush_every`` for large-H models on small-HBM chips).
+    """
     n = len(texts)
     out = np.empty((n, encoder.embedding_size), dtype=np.float32)
     if n == 0:
         return out
     order = sorted(range(n), key=lambda i: len(texts[i].split()))
+    pending: list[tuple[list[int], jnp.ndarray]] = []
+
+    def flush() -> None:
+        for idx, dev in pending:
+            out[idx] = np.asarray(dev, dtype=np.float32)[: len(idx)]
+        pending.clear()
+
     for lo in range(0, n, batch_size):
         idx = order[lo : lo + batch_size]
         batch = encoder.tokenizer([texts[i] for i in idx])
@@ -48,7 +64,10 @@ def compute_embeddings(
         pooled = pooler.pool(hidden, batch.attention_mask)
         if normalize:
             pooled = pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
-        out[idx] = np.asarray(pooled, dtype=np.float32)[: len(idx)]
+        pending.append((idx, pooled))
+        if len(pending) >= flush_every:
+            flush()
+    flush()
     return out
 
 

@@ -11,6 +11,7 @@ request per HTTP call.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -75,31 +76,45 @@ class BatchingClient:
                     self._cond.wait()
                 if self._closed and not self._queue:
                     return
-                # Collect until full or quiet for batch_timeout.
-                deadline_passed = False
-                while (
-                    len(self._queue) < self.batch_size and not deadline_passed
-                ):
-                    before = len(self._queue)
-                    self._cond.wait(timeout=self.batch_timeout)
-                    deadline_passed = len(self._queue) == before
+                # Collect until full or batch_timeout after the first arrival
+                # (a fixed per-batch deadline, not a rolling quiet period —
+                # steady sub-timeout arrivals must not starve the batch).
+                deadline = time.monotonic() + self.batch_timeout
+                while len(self._queue) < self.batch_size:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
                 batch = [
                     p for p in self._queue[: self.batch_size] if not p.abandoned
                 ]
                 del self._queue[: self.batch_size]
                 if not batch:
                     continue
-            try:
-                responses = self._send_batch([p.prompt for p in batch])
-                for pending, response in zip(batch, responses):
-                    pending.result = response
-                    pending.event.set()
-            except Exception as exc:  # noqa: BLE001 - delivered to callers
-                for pending in batch:
-                    pending.error = exc
-                    pending.event.set()
+            self._dispatch(batch)
             self.batches_sent += 1
             self.requests_sent += len(batch)
+
+    def _dispatch(self, batch: list[_Pending]) -> None:
+        try:
+            responses = self._send_batch([p.prompt for p in batch])
+            if len(responses) != len(batch):
+                raise RuntimeError(
+                    f'send_batch returned {len(responses)} responses for '
+                    f'{len(batch)} prompts'
+                )
+            for pending, response in zip(batch, responses):
+                pending.result = response
+                pending.event.set()
+        except Exception as exc:  # noqa: BLE001 - delivered to callers
+            if len(batch) > 1:
+                # Isolate the failure: retry each prompt alone so one poison
+                # prompt doesn't error (and re-enqueue) the healthy ones.
+                for pending in batch:
+                    self._dispatch([pending])
+                return
+            batch[0].error = exc
+            batch[0].event.set()
 
     def close(self) -> None:
         with self._cond:

@@ -75,32 +75,45 @@ class CheckpointManager:
         return checkpoints[-1] if checkpoints else None
 
     def try_resume(self) -> int:
-        """Load the latest compatible checkpoint; returns #completed."""
-        latest = self.find_latest(self.checkpoint_dir)
-        if latest is None:
-            return 0
+        """Load the newest compatible checkpoint; returns #completed.
+
+        Falls back through the retained checkpoints (newest → oldest) so a
+        corrupt or incompatible newest file doesn't discard the older valid
+        ones.
+        """
+        candidates = sorted(
+            self.checkpoint_dir.glob('checkpoint_*.json'), reverse=True
+        )
+        for path in candidates:
+            results = self._load_compatible(path)
+            if results is not None:
+                with self._lock:
+                    self.results = results
+                print(
+                    f'[checkpoint] resumed {len(results)} results '
+                    f'from {path.name}'
+                )
+                return len(results)
+        return 0
+
+    def _load_compatible(self, path: Path) -> dict[int, dict[str, Any]] | None:
         try:
-            payload = json.loads(latest.read_text())
-        except json.JSONDecodeError:
-            print(f'[checkpoint] ignoring corrupt {latest}')
-            return 0
+            payload = json.loads(path.read_text())
+        except (json.JSONDecodeError, OSError):
+            print(f'[checkpoint] ignoring corrupt {path}')
+            return None
         if payload.get('version') != CHECKPOINT_VERSION:
-            print(f'[checkpoint] version mismatch in {latest}; ignoring')
-            return 0
+            print(f'[checkpoint] version mismatch in {path}; ignoring')
+            return None
         meta = payload.get('metadata', {})
         for key in ('model', 'questions_file'):
             if key in self.metadata and meta.get(key) != self.metadata[key]:
                 print(
-                    f'[checkpoint] {key} mismatch '
+                    f'[checkpoint] {key} mismatch in {path.name} '
                     f'({meta.get(key)!r} != {self.metadata[key]!r}); ignoring'
                 )
-                return 0
-        with self._lock:
-            self.results = {
-                int(k): v for k, v in payload.get('results', {}).items()
-            }
-        print(f'[checkpoint] resumed {len(self.results)} results from {latest.name}')
-        return len(self.results)
+                return None
+        return {int(k): v for k, v in payload.get('results', {}).items()}
 
     @property
     def completed_indices(self) -> set[int]:

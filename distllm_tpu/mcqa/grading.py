@@ -10,7 +10,6 @@ in exponential backoff. Auth errors give up immediately
 from __future__ import annotations
 
 import json
-import re
 from typing import Callable
 
 from distllm_tpu.utils import expo_backoff_retry
@@ -40,23 +39,26 @@ _PROMPT_LADDER = [
     ),
 ]
 
-_JSON_RE = re.compile(r'\{.*\}', re.DOTALL)
-
-
 def parse_grader_json(response: str) -> dict | None:
-    """Extract the first JSON object with a boolean 'correct' field."""
-    match = _JSON_RE.search(response)
-    if not match:
-        return None
-    try:
-        payload = json.loads(match.group(0))
-    except json.JSONDecodeError:
-        return None
-    if not isinstance(payload, dict) or not isinstance(
-        payload.get('correct'), bool
-    ):
-        return None
-    return payload
+    """Extract the first JSON object with a boolean 'correct' field.
+
+    Decodes at each '{' with ``raw_decode`` so a valid verdict followed by
+    stray braces (grader prose) still parses.
+    """
+    decoder = json.JSONDecoder()
+    pos = response.find('{')
+    while pos != -1:
+        try:
+            payload, _ = decoder.raw_decode(response, pos)
+        except json.JSONDecodeError:
+            pos = response.find('{', pos + 1)
+            continue
+        if isinstance(payload, dict) and isinstance(
+            payload.get('correct'), bool
+        ):
+            return payload
+        pos = response.find('{', pos + 1)
+    return None
 
 
 def grade_answer(

@@ -25,7 +25,6 @@ from distllm_tpu.mcqa.batching import BatchingClient
 from distllm_tpu.mcqa.checkpoint import CheckpointManager
 from distllm_tpu.mcqa.config import MCQAConfig
 from distllm_tpu.mcqa.grading import grade_answer
-from distllm_tpu.utils import expo_backoff_retry
 
 
 # --------------------------------------------------------------- chunk ids
@@ -145,10 +144,9 @@ class RagAnswerer:
                 'Output only your chosen option.\nAnswer: '
             )
 
-        def call() -> str:
-            return self.client.generate(prompt, timeout=600)
-
-        response = expo_backoff_retry(call, max_tries=5, base_delay=1.0)
+        # No outer retry: the transport (ApiGenerator._chat) already does
+        # exponential backoff; a second layer here would multiply attempts.
+        response = self.client.generate(prompt, timeout=600)
         return {'answer': response, 'retrieval': retrieval_log, 'prompt': prompt}
 
 
@@ -166,8 +164,13 @@ def retrieval_metrics(results: dict[int, dict[str, Any]]) -> dict[str, float]:
         if source:
             chunk_total += 1
             chunk_hits += any(r['chunk_id'] == source for r in retrieved)
-        qhash = question.get('question_hash')
-        if qhash:
+        # Only meaningful when the index's chunks carry question-hash
+        # metadata (chunks from question-generation pipelines, v3:594-641);
+        # the hash of the current question is computed when absent.
+        if any('question_hash' in r for r in retrieved):
+            qhash = question.get('question_hash') or question_hash(
+                question.get('question', '')
+            )
             hash_total += 1
             hash_hits += any(
                 r.get('question_hash') == qhash for r in retrieved

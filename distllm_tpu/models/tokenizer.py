@@ -25,14 +25,28 @@ import numpy as np
 
 
 def bucket_ladder(max_length: int, min_bucket: int = 16) -> list[int]:
-    """Geometric (x2) ladder of sequence buckets up to ``max_length``."""
+    """Ladder of sequence buckets up to ``max_length``.
+
+    Geometric (x2) up to 64, then linear steps of 32 (to 256), 64 (to 512),
+    and 128 beyond. Finer rungs than a pure x2 ladder cut padding waste from
+    ~35% to ~10% on chunk-sized text (120-260 tokens) while keeping the
+    number of compiled programs small — with length-sorted batching only a
+    handful of rungs are ever touched.
+    """
     if max_length < 1:
         raise ValueError(f'max_length must be >= 1, got {max_length}')
     buckets: list[int] = []
     b = min(min_bucket, max_length)
     while b < max_length:
         buckets.append(b)
-        b *= 2
+        if b < 64:
+            b *= 2
+        elif b < 256:
+            b += 32
+        elif b < 512:
+            b += 64
+        else:
+            b += 128
     buckets.append(max_length)
     return buckets
 
@@ -126,13 +140,18 @@ class WhitespaceTokenizer(_BucketingMixin):
         self._n_special = 4
         self.buckets = bucket_ladder(model_max_length, min_bucket)
         self._reverse: dict[int, str] = {}
+        self._cache: dict[str, int] = {}
 
     def token_id(self, token: str) -> int:
+        tid = self._cache.get(token)
+        if tid is not None:
+            return tid
         digest = hashlib.sha1(token.encode()).digest()
         tid = self._n_special + int.from_bytes(digest[:4], 'little') % (
             self.vocab_size - self._n_special
         )
         self._reverse.setdefault(tid, token)
+        self._cache[token] = tid
         return tid
 
     def __call__(

@@ -51,8 +51,11 @@ def test_shard_pytree_matmul():
 
 
 def test_bucket_ladder():
-    assert bucket_ladder(512, 16) == [16, 32, 64, 128, 256, 512]
-    assert bucket_ladder(100, 16) == [16, 32, 64, 100]
+    assert bucket_ladder(512, 16) == [
+        16, 32, 64, 96, 128, 160, 192, 224, 256, 320, 384, 448, 512,
+    ]
+    assert bucket_ladder(100, 16) == [16, 32, 64, 96, 100]
+    assert bucket_ladder(1024, 16)[-3:] == [768, 896, 1024]
     assert pick_bucket(33, [16, 32, 64]) == 64
     assert pick_bucket(999, [16, 32, 64]) == 64
 
