@@ -42,6 +42,11 @@ class AutoEncoderConfig(BaseConfig):
     )
     model_max_length: int | None = None
     trust_remote_code: bool = False
+    quantization: bool | Literal['int8', 'nf4'] = Field(
+        default=False,
+        description='Weight-only quantization; True means nf4 (the '
+        "reference's bitsandbytes NF4 load path, auto.py:46-56).",
+    )
 
 
 class AutoEncoder(JaxEncoder):
@@ -65,6 +70,8 @@ class AutoEncoder(JaxEncoder):
             or hf_cfg.get('max_position_embeddings'),
             trust_remote_code=config.trust_remote_code,
         )
+        from distllm_tpu.ops.quantization import normalize_mode
+
         super().__init__(
             config=config,
             apply_fn=module.apply,
@@ -72,6 +79,7 @@ class AutoEncoder(JaxEncoder):
             params=params,
             tokenizer=tokenizer,
             embedding_size=model_cfg.hidden_size,
+            quantization=normalize_mode(config.quantization),
         )
         self._module = module
 

@@ -43,15 +43,36 @@ class JaxEncoder:
         params,
         tokenizer,
         embedding_size: int,
+        quantization: str | None = None,
     ) -> None:
         self.config = config
         self.model_cfg = model_cfg
-        self.params = params
         self._tokenizer = tokenizer
         self.embedding_size = embedding_size
-        self._forward = jax.jit(
-            lambda p, ids, mask: apply_fn(p, model_cfg, ids, mask)
-        )
+        if quantization:
+            # Weight-only quantization (reference: NF4 via bitsandbytes,
+            # auto.py:46-56): store int8/nf4 codes in HBM, dequantize to the
+            # compute dtype inside the jitted forward.
+            from distllm_tpu.ops.quantization import (
+                dequantize_pytree,
+                quantize_pytree,
+            )
+
+            params = quantize_pytree(
+                params,
+                mode=quantization,
+                out_dtype=getattr(model_cfg, 'dtype', 'bfloat16'),
+            )
+            self._forward = jax.jit(
+                lambda p, ids, mask: apply_fn(
+                    dequantize_pytree(p), model_cfg, ids, mask
+                )
+            )
+        else:
+            self._forward = jax.jit(
+                lambda p, ids, mask: apply_fn(p, model_cfg, ids, mask)
+            )
+        self.params = params
 
     @property
     def tokenizer(self):

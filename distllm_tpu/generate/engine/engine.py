@@ -77,6 +77,7 @@ class EngineConfig(BaseConfig):
     prefill_min_bucket: int = 16
     prefer_native_allocator: bool = True
     attn_backend: str = 'xla'  # 'xla' | 'pallas' (TPU decode kernel)
+    quantization: str | None = None  # None | 'int8' | 'nf4' (weight-only)
     seed: int = 0
 
 
@@ -107,7 +108,7 @@ class LLMEngine:
         )
         self.max_blocks_per_seq = self.kv.blocks_needed(cfg.max_model_len)
         self.prefill_buckets = bucket_ladder(
-            cfg.max_model_len, cfg.prefill_min_bucket
+            cfg.max_model_len, cfg.prefill_min_bucket, scheme='pow2'
         )
 
         self._waiting: list[Request] = []
@@ -118,7 +119,24 @@ class LLMEngine:
 
         model = self.model_cfg
 
+        if cfg.quantization:
+            # Weight-only quantized serving (reference: bnb NF4 in the HF
+            # generator, huggingface_backend.py:66-77): codes live in HBM,
+            # dequant happens inside the compiled step.
+            from distllm_tpu.ops.quantization import (
+                dequantize_pytree as _deq,
+                quantize_pytree,
+            )
+
+            self.params = quantize_pytree(
+                self.params, mode=cfg.quantization, out_dtype=model.dtype
+            )
+        else:
+            def _deq(p):
+                return p
+
         def prefill_fn(params, ids, mask):
+            params = _deq(params)
             hidden, k, v = mistral.prefill(params, model, ids, mask)
             return mistral.logits(params, model, hidden), k, v
 
@@ -127,7 +145,7 @@ class LLMEngine:
         attn_backend = cfg.attn_backend
         self._decode = jax.jit(
             lambda params, ids, pos, k, v, bt, ctx: mistral.decode_step(
-                params, model, ids, pos, k, v, bt, ctx,
+                _deq(params), model, ids, pos, k, v, bt, ctx,
                 attn_backend=attn_backend,
             ),
             donate_argnums=(3, 4),

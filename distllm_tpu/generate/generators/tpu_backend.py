@@ -14,6 +14,7 @@ from typing import Literal
 from pydantic import Field, model_validator
 
 from distllm_tpu.generate.engine import EngineConfig, LLMEngine, SamplingParams
+from distllm_tpu.ops.quantization import normalize_mode, quantize_pytree
 from distllm_tpu.utils import BaseConfig
 
 
@@ -36,6 +37,11 @@ class TpuGeneratorConfig(BaseConfig):
     num_blocks: int = 2048
     max_num_seqs: int = 16
     max_model_len: int = 4096
+    quantization: bool | Literal['int8', 'nf4'] = Field(
+        default=False,
+        description='Weight-only quantized serving; True means nf4 (the '
+        "reference's bitsandbytes NF4 option).",
+    )
 
     @model_validator(mode='after')
     def _xor_top_p_min_p(self) -> 'TpuGeneratorConfig':
@@ -62,6 +68,13 @@ class TpuGenerator:
         params = mistral.params_from_hf(
             read_checkpoint(config.pretrained_model_name_or_path), model_cfg
         )
+        quant_mode = normalize_mode(config.quantization)
+        if quant_mode:
+            # Quantize BEFORE sharding so codes are placed once (QTensor
+            # leaves replicate; float leaves take their TP specs).
+            params = quantize_pytree(
+                params, mode=quant_mode, out_dtype=model_cfg.dtype
+            )
         if config.tensor_parallel_size > 1:
             mesh = make_mesh(
                 MeshSpec(data=1, model=config.tensor_parallel_size),
@@ -85,6 +98,7 @@ class TpuGenerator:
                 num_blocks=config.num_blocks,
                 max_num_seqs=config.max_num_seqs,
                 max_model_len=config.max_model_len,
+                quantization=quant_mode,
             ),
         )
 

