@@ -50,6 +50,13 @@ def compute_embeddings(
         return out
     order = sorted(range(n), key=lambda i: len(texts[i].split()))
     pending: list[tuple[list[int], jnp.ndarray]] = []
+    # Fused encode+pool (one dispatch/batch) when the encoder supports it;
+    # composed per-stage dispatches otherwise (e.g. FakeEncoder).
+    fused = (
+        encoder.pooled_forward(pooler, normalize)
+        if hasattr(encoder, 'pooled_forward')
+        else None
+    )
 
     def flush() -> None:
         for idx, dev in pending:
@@ -60,10 +67,22 @@ def compute_embeddings(
         idx = order[lo : lo + batch_size]
         batch = encoder.tokenizer([texts[i] for i in idx])
         batch = batch.pad_batch_to(batch_size, pad_id=encoder.tokenizer.pad_id)
-        hidden = encoder.forward(batch)
-        pooled = pooler.pool(hidden, batch.attention_mask)
-        if normalize:
-            pooled = pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        if fused is not None:
+            pooled = fused(batch)
+        else:
+            pooled = pooler.pool(encoder.forward(batch), batch.attention_mask)
+            if normalize:
+                # Same guarded normalize as the fused path (zero vectors from
+                # fully-masked pad rows must not produce NaN).
+                pooled = pooled / jnp.clip(
+                    jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+                )
+            pooled = pooled.astype(jnp.float32)
+        # Start the device→host copy now so it overlaps later batches'
+        # compute; flush()'s np.asarray then finds the bytes already local.
+        copy_async = getattr(pooled, 'copy_to_host_async', None)
+        if copy_async is not None:
+            copy_async()
         pending.append((idx, pooled))
         if len(pending) >= flush_every:
             flush()

@@ -63,15 +63,13 @@ class JaxEncoder:
                 mode=quantization,
                 out_dtype=getattr(model_cfg, 'dtype', 'bfloat16'),
             )
-            self._forward = jax.jit(
-                lambda p, ids, mask: apply_fn(
-                    dequantize_pytree(p), model_cfg, ids, mask
-                )
+            self._apply = lambda p, ids, mask: apply_fn(
+                dequantize_pytree(p), model_cfg, ids, mask
             )
         else:
-            self._forward = jax.jit(
-                lambda p, ids, mask: apply_fn(p, model_cfg, ids, mask)
-            )
+            self._apply = lambda p, ids, mask: apply_fn(p, model_cfg, ids, mask)
+        self._forward = jax.jit(self._apply)
+        self._pooled_cache: dict = {}
         self.params = params
 
     @property
@@ -85,6 +83,37 @@ class JaxEncoder:
     def forward(self, batch: TokenBatch) -> jnp.ndarray:
         return self._forward(self.params, batch.input_ids, batch.attention_mask)
 
+    def pooled_forward(self, pooler, normalize: bool = False):
+        """Fused encode→pool(→normalize)→fp32 as ONE jitted dispatch.
+
+        One device round trip per batch instead of two/three keeps the hot
+        loop off the dispatch-latency floor (dominant when the chip sits
+        behind a remote tunnel); XLA also fuses the pooling reduction into
+        the final layer's epilogue instead of re-reading ``[B, S, H]``.
+        Cached per (pooler, normalize) so bucketed shapes re-specialize the
+        same traced function.
+        """
+        key = (type(pooler).__name__, normalize)
+        fused = self._pooled_cache.get(key)
+        if fused is None:
+            apply = self._apply
+
+            def _fused(p, ids, mask):
+                pooled = pooler.pool(apply(p, ids, mask), mask)
+                if normalize:
+                    pooled = pooled / jnp.clip(
+                        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+                    )
+                return pooled.astype(jnp.float32)
+
+            fused = jax.jit(_fused)
+            self._pooled_cache[key] = fused
+
+        def run(batch: TokenBatch) -> jnp.ndarray:
+            return fused(self.params, batch.input_ids, batch.attention_mask)
+
+        return run
+
     def shard(self, mesh, specs) -> None:
         """Place params on a mesh (TP/DP); jitted fns re-specialize lazily."""
         from distllm_tpu.parallel.sharding import shard_pytree
@@ -95,3 +124,4 @@ class JaxEncoder:
         """Release HBM references so a swapped-in model can fit."""
         self.params = None
         self._forward = None
+        self._pooled_cache.clear()

@@ -95,7 +95,7 @@ class BatchingClient:
             self.batches_sent += 1
             self.requests_sent += len(batch)
 
-    def _dispatch(self, batch: list[_Pending]) -> bool:
+    def _dispatch(self, batch: list[_Pending], depth: int = 0) -> bool:
         try:
             responses = self._send_batch([p.prompt for p in batch])
             if len(responses) != len(batch):
@@ -108,32 +108,32 @@ class BatchingClient:
                 pending.event.set()
             return True
         except Exception as exc:  # noqa: BLE001 - delivered to callers
-            if len(batch) > 1:
+            if len(batch) > 1 and depth < 2:
                 # Isolate the failure: retry prompts alone so one poison
-                # prompt doesn't error the healthy ones. But if retries fail
-                # back-to-back the backend itself is down — fail the rest
-                # fast instead of serializing a full transport-backoff ladder
-                # per prompt (which would block the only dispatch thread for
-                # batch_size x backoff and cascade into caller timeouts).
+                # prompt doesn't error the healthy ones. If two retries fail
+                # back-to-back, stop serializing backoff ladders — but a
+                # failing pair may just be adjacent poison prompts, so the
+                # UNTRIED remainder gets one batch-level retry (bounded by
+                # ``depth``) instead of inheriting another prompt's error.
+                # Backend-down worst case: ~2 batch sends + 4 single sends.
                 consecutive = 0
-                last_error = exc
-                for pending in batch:
+                for i, pending in enumerate(batch):
                     if pending.abandoned:
                         # Caller already timed out; don't burn a transport
                         # backoff ladder on a result nobody will read.
                         continue
                     if consecutive >= 2:
-                        pending.error = last_error
-                        pending.event.set()
-                        continue
-                    if self._dispatch([pending]):
+                        remainder = [p for p in batch[i:] if not p.abandoned]
+                        self._dispatch(remainder, depth + 1)
+                        return False
+                    if self._dispatch([pending], depth + 1):
                         consecutive = 0
                     else:
                         consecutive += 1
-                        last_error = pending.error or last_error
                 return False
-            batch[0].error = exc
-            batch[0].event.set()
+            for pending in batch:
+                pending.error = exc
+                pending.event.set()
             return False
 
     def close(self) -> None:

@@ -161,11 +161,17 @@ def retrieval_metrics(results: dict[int, dict[str, Any]]) -> dict[str, float]:
     # question-hash metadata (chunks from question-generation pipelines,
     # v3:594-641) — decided globally, so a question whose retrieval came
     # back empty still counts as a miss rather than dropping out of the
-    # denominator (which would inflate the rate).
+    # denominator (which would inflate the rate). A total retrieval miss
+    # would hide the hash evidence, so hash-annotated *questions* also mark
+    # the metric applicable — then a zero-retrieval run reports 0.0 instead
+    # of silently omitting the metric.
     hashes_in_corpus = any(
         'question_hash' in r
         for result in results.values()
         for r in result.get('retrieval', [])
+    ) or any(
+        'question_hash' in result.get('entry', {})
+        for result in results.values()
     )
     for result in results.values():
         question = result.get('entry', {})

@@ -123,9 +123,22 @@ def apply(
     cfg: MistralConfig,
     input_ids: jnp.ndarray,
     attention_mask: jnp.ndarray,
+    *,
+    mesh=None,
+    seq_parallel: str | None = None,
 ) -> jnp.ndarray:
-    """Dense causal forward: ``[B, S]`` → last hidden states ``[B, S, H]``."""
-    hidden, _, _ = _forward(params, cfg, input_ids, attention_mask, collect_kv=False)
+    """Dense causal forward: ``[B, S]`` → last hidden states ``[B, S, H]``.
+
+    ``seq_parallel`` (``'ring'`` or ``'ulysses'``) activates sequence/context
+    parallelism over ``mesh``'s ``seq`` axis: activations stay sharded
+    ``S/P`` per chip and attention runs as ring ppermutes / all-to-alls
+    (``distllm_tpu.ops.ring_attention``) — the long-context capability the
+    reference lacks entirely (it truncates, ``auto.py:74``; SURVEY.md §5).
+    """
+    hidden, _, _ = _forward(
+        params, cfg, input_ids, attention_mask, collect_kv=False,
+        mesh=mesh, seq_parallel=seq_parallel,
+    )
     return hidden
 
 
@@ -139,12 +152,24 @@ def prefill(
     return _forward(params, cfg, input_ids, attention_mask, collect_kv=True)
 
 
-def _forward(params, cfg, input_ids, attention_mask, *, collect_kv):
+def _forward(
+    params, cfg, input_ids, attention_mask, *, collect_kv,
+    mesh=None, seq_parallel=None,
+):
     dtype = jnp.dtype(cfg.dtype)
     b, s = input_ids.shape
     cos, sin = _rope_tables(cfg, s)
     x = jnp.asarray(params['embed'])[input_ids].astype(dtype)
-    mask = _attn_mask(attention_mask, cfg)
+    use_sp = (
+        seq_parallel is not None
+        and mesh is not None
+        and mesh.shape.get('seq', 1) > 1
+    )
+    if use_sp and cfg.sliding_window is not None:
+        raise NotImplementedError(
+            'sequence parallelism with sliding-window attention'
+        )
+    mask = None if use_sp else _attn_mask(attention_mask, cfg)
     positions = None  # prefill positions are 0..S-1 per row
 
     def layer(x, lp):
@@ -154,8 +179,26 @@ def _forward(params, cfg, input_ids, attention_mask, *, collect_kv):
         v = common.split_heads(common.dense(normed, lp['v']['kernel']), cfg.num_kv_heads)
         q = common.apply_rope(q, cos, sin, positions)
         k = common.apply_rope(k, cos, sin, positions)
-        # GQA handled natively by the fused attention (no KV materialization).
-        attn = common.sdpa(q, k, v, mask=mask)
+        if use_sp:
+            from distllm_tpu.ops.ring_attention import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            sp_fn = ring_attention if seq_parallel == 'ring' else ulysses_attention
+            n_rep = cfg.num_heads // cfg.num_kv_heads
+            attn = sp_fn(
+                q,
+                common.repeat_kv(k, n_rep),
+                common.repeat_kv(v, n_rep),
+                mesh,
+                kv_mask=attention_mask,
+                causal=True,
+            )
+        else:
+            # GQA handled natively by the fused attention (no KV
+            # materialization).
+            attn = common.sdpa(q, k, v, mask=mask)
         x = x + common.dense(common.merge_heads(attn), lp['o']['kernel'])
         normed2 = common.rms_norm(x, lp['mlp_ln']['scale'], cfg.rms_norm_eps)
         mlp = common.dense(

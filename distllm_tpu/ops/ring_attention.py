@@ -1,0 +1,251 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has **no** long-context strategy — it truncates to
+``model_max_length`` (``distllm/embed/encoders/auto.py:74``) or chunks text
+(``embed/datasets/jsonl_chunk.py``; SURVEY.md §5 "Long-context"). Here
+sequence parallelism is first-class: inputs longer than one chip's HBM are
+sharded over the ``seq`` mesh axis and attention runs distributed:
+
+- :func:`ring_attention` — blockwise attention with online-softmax
+  accumulation; K/V blocks rotate around the ring via ``lax.ppermute`` so
+  each chip only ever holds ``S/P`` keys (memory O(S/P), comm rides ICI
+  neighbor links). This is the Ring Attention construction (Liu et al.) in
+  its jax/shard_map form.
+- :func:`ulysses_attention` — all-to-all alternative: scatter heads /
+  gather sequence, run full local attention per head group, reverse. One
+  collective pair instead of P-1 permutes; better when heads >= ring size
+  and ICI all-to-all bandwidth is plentiful.
+
+Both are exact (not approximations): tests pin them against single-device
+full attention in fp32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn_update(q, k_blk, v_blk, mask_blk, m, l, o, scale):
+    """One online-softmax accumulation step against a K/V block.
+
+    q ``[B, Sq, N, H]``; k_blk/v_blk ``[B, Sb, N, H]``; mask_blk boolean
+    ``[B, N, Sq, Sb]`` (True = attend). Running stats: m/l ``[B, N, Sq]``,
+    o ``[B, Sq, N, H]`` — all fp32.
+    """
+    s = jnp.einsum(
+        'bqnh,bknh->bnqk',
+        q.astype(jnp.float32),
+        k_blk.astype(jnp.float32),
+    ) * scale
+    s = jnp.where(mask_blk, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # Rows with no valid key yet keep m == NEG_INF; exp(s - m) would be
+    # exp(0) there, but l stays 0 and the final divide guards against it.
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(mask_blk, p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        'bnqk,bknh->bqnh', p, v_blk.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(
+    q,
+    k,
+    v,
+    kv_mask,
+    *,
+    axis_name: str,
+    causal: bool,
+    scale: float,
+):
+    """Per-shard ring attention body (run under ``shard_map``).
+
+    Shapes (local shard): q/k/v ``[B, S_loc, N, H]``, kv_mask ``[B, S_loc]``
+    boolean. Sequence is sharded contiguously: shard ``i`` holds global
+    positions ``[i*S_loc, (i+1)*S_loc)``.
+    """
+    ring_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_loc, n, h = q.shape
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    q_pos = my_idx * s_loc + jnp.arange(s_loc)  # global query positions
+
+    m0 = jnp.full((b, n, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n, s_loc), jnp.float32)
+    o0 = jnp.zeros((b, s_loc, n, h), jnp.float32)
+
+    def body(step, carry):
+        m, l, o, k_blk, v_blk, mask_blk = carry
+        # After `step` rotations we hold the block originating at shard
+        # (my_idx - step) mod P.
+        src = (my_idx - step) % ring_size
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        block_mask = mask_blk[:, None, None, :]  # [B, 1, 1, Sb]
+        if causal:
+            block_mask = block_mask & (
+                k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+            )
+        block_mask = jnp.broadcast_to(block_mask, (b, n, s_loc, s_loc))
+        m, l, o = _block_attn_update(q, k_blk, v_blk, block_mask, m, l, o, scale)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return m, l, o, k_blk, v_blk, mask_blk
+
+    m, l, o, _, _, _ = lax.fori_loop(
+        0, ring_size, body, (m0, l0, o0, k, v, kv_mask)
+    )
+    out = o / jnp.clip(l, 1e-30, None).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    kv_mask: jnp.ndarray | None = None,
+    causal: bool = False,
+    scale: float | None = None,
+    axis: str = 'seq',
+    batch_axis: str | None = 'data',
+) -> jnp.ndarray:
+    """Exact attention over sequence-sharded ``[B, S, N, H]`` tensors.
+
+    ``q``/``k``/``v`` must have equal head counts (apply
+    :func:`distllm_tpu.models.common.repeat_kv` first for GQA). ``kv_mask``
+    is a boolean ``[B, S]`` key-validity mask (padding); ``None`` means all
+    keys valid. Batch may additionally be sharded over ``batch_axis``.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if kv_mask is None:
+        kv_mask = jnp.ones(k.shape[:2], bool)
+    bspec = batch_axis if batch_axis in mesh.shape else None
+    qkv_spec = P(bspec, axis, None, None)
+    mask_spec = P(bspec, axis)
+    fn = jax.shard_map(
+        partial(
+            _ring_attention_local,
+            axis_name=axis,
+            causal=causal,
+            scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kv_mask.astype(bool))
+
+
+def _ulysses_local(q, k, v, kv_mask, *, axis_name: str, causal: bool, scale: float):
+    """Ulysses body: all_to_all heads<->sequence, local full attention, undo.
+
+    Local shapes in: ``[B, S_loc, N, H]`` with N divisible by the axis size.
+    After the first all_to_all each chip holds the FULL sequence for N/P
+    heads; attention is ordinary full attention; the second all_to_all
+    restores sequence sharding.
+    """
+    p_size = lax.axis_size(axis_name)
+    b, s_loc, n, h = q.shape
+
+    def scatter_heads(x):
+        # [B, S_loc, N, H] -> [B, P*S_loc, N/P, H]: device d keeps the
+        # contiguous head group d for the FULL sequence (tiled all_to_all:
+        # head axis divided by P, seq axis concatenated in ring order).
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def gather_seq_mask(mask):
+        # [B, S_loc] -> [B, P*S_loc] (every chip needs the full key mask)
+        return lax.all_gather(mask, axis_name, axis=1, tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    mask_g = gather_seq_mask(kv_mask)  # [B, S_glob]
+    s_glob = p_size * s_loc
+
+    # Blockwise online-softmax over key blocks of S_loc: peak score-matrix
+    # memory is O(S_glob * S_loc) per chip instead of O(S_glob^2) — the
+    # whole point of sharding the sequence in the first place.
+    n_loc = n // p_size
+    q_pos = jnp.arange(s_glob)
+    m0 = jnp.full((b, n_loc, s_glob), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n_loc, s_glob), jnp.float32)
+    o0 = jnp.zeros((b, s_glob, n_loc, h), jnp.float32)
+
+    def body(i, carry):
+        m, l, o = carry
+        k_blk = lax.dynamic_slice_in_dim(kg, i * s_loc, s_loc, axis=1)
+        v_blk = lax.dynamic_slice_in_dim(vg, i * s_loc, s_loc, axis=1)
+        mask_blk = lax.dynamic_slice_in_dim(mask_g, i * s_loc, s_loc, axis=1)
+        k_pos = i * s_loc + jnp.arange(s_loc)
+        block_mask = mask_blk[:, None, None, :]
+        if causal:
+            block_mask = block_mask & (
+                k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+            )
+        block_mask = jnp.broadcast_to(block_mask, (b, n_loc, s_glob, s_loc))
+        m, l, o = _block_attn_update(qg, k_blk, v_blk, block_mask, m, l, o, scale)
+        return m, l, o
+
+    m, l, og = lax.fori_loop(0, p_size, body, (m0, l0, o0))
+    og = (og / jnp.clip(l, 1e-30, None).transpose(0, 2, 1)[..., None]).astype(
+        q.dtype
+    )
+
+    # [B, S_glob, N/P, H] -> [B, S_loc, N, H]: seq axis divided back to the
+    # local block; head groups concatenate in source order, restoring the
+    # original head ordering.
+    return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    kv_mask: jnp.ndarray | None = None,
+    causal: bool = False,
+    scale: float | None = None,
+    axis: str = 'seq',
+    batch_axis: str | None = 'data',
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses construction).
+
+    Requires ``num_heads %% mesh.shape[axis] == 0``. Same exact semantics as
+    :func:`ring_attention`; different collective pattern (one all_to_all pair
+    + mask all_gather instead of P-1 ppermutes).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if kv_mask is None:
+        kv_mask = jnp.ones(k.shape[:2], bool)
+    p_size = mesh.shape[axis]
+    if q.shape[2] % p_size != 0:
+        raise ValueError(
+            f'ulysses needs heads ({q.shape[2]}) divisible by the {axis!r} '
+            f'axis size ({p_size}); use ring_attention instead'
+        )
+    bspec = batch_axis if batch_axis in mesh.shape else None
+    qkv_spec = P(bspec, axis, None, None)
+    fn = jax.shard_map(
+        partial(_ulysses_local, axis_name=axis, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(bspec, axis)),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kv_mask.astype(bool))
