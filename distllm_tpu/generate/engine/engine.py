@@ -9,7 +9,9 @@ The TPU-native replacement for the vLLM offline engine the reference wraps
   (``max_num_seqs`` slots), paged attention over block tables, per-slot
   sampling params (temperature / top-p / min-p / greedy);
 - **scheduler**: waiting → running admission under block budget, vLLM-style
-  recompute preemption when the pool runs dry mid-decode;
+  recompute preemption when the pool runs dry mid-decode — implemented as a
+  NATIVE C++ core (``distllm_tpu/native/scheduler.cpp`` via
+  ``engine/scheduler.py``, Python twin as fallback/oracle);
 - requests join and leave the batch between steps — continuous batching.
 
 The KV caches are donated through the jitted step so XLA updates them in
@@ -27,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distllm_tpu.generate.engine.kv_cache import PagedKVCache
+from distllm_tpu.generate.engine.scheduler import make_scheduler
 from distllm_tpu.models import mistral
 from distllm_tpu.models.tokenizer import bucket_ladder, pick_bucket
 from distllm_tpu.ops.paged_attention import write_prefill_kv
@@ -58,8 +61,6 @@ class Request:
     params: SamplingParams
     state: RequestState = RequestState.WAITING
     output_ids: list[int] = field(default_factory=list)
-    blocks: list[int] = field(default_factory=list)
-    slot: int | None = None
 
     @property
     def num_tokens(self) -> int:
@@ -75,6 +76,7 @@ class EngineConfig(BaseConfig):
     max_num_seqs: int = 8
     max_model_len: int = 1024
     prefill_min_bucket: int = 16
+    # Governs the scheduler implementation (C++ core vs Python twin).
     prefer_native_allocator: bool = True
     attn_backend: str = 'xla'  # 'xla' | 'pallas' (TPU decode kernel)
     quantization: str | None = None  # None | 'int8' | 'nf4' (weight-only)
@@ -104,15 +106,21 @@ class LLMEngine:
             num_kv_heads=model_cfg.num_kv_heads,
             head_dim=model_cfg.head_size,
             dtype=model_cfg.dtype,
-            prefer_native_allocator=cfg.prefer_native_allocator,
         )
         self.max_blocks_per_seq = self.kv.blocks_needed(cfg.max_model_len)
         self.prefill_buckets = bucket_ladder(
             cfg.max_model_len, cfg.prefill_min_bucket, scheme='pow2'
         )
 
-        self._waiting: list[Request] = []
-        self._slots: list[Request | None] = [None] * cfg.max_num_seqs
+        # All admission / preemption / block-budget decisions live in the
+        # scheduler (native C++ core, Python twin fallback).
+        self.sched = make_scheduler(
+            cfg.num_blocks,
+            cfg.block_size,
+            cfg.max_num_seqs,
+            prefer_native=cfg.prefer_native_allocator,
+        )
+        self._requests: dict[int, Request] = {}
         self._next_id = itertools.count()
         self._finished: dict[int, Request] = {}
         self._key = jax.random.PRNGKey(cfg.seed)
@@ -174,70 +182,29 @@ class LLMEngine:
             prompt_ids=list(prompt_ids),
             params=params or SamplingParams(),
         )
-        self._waiting.append(request)
+        self._requests[request.request_id] = request
+        self.sched.add(request.request_id, request.num_tokens)
         return request.request_id
 
     @property
     def has_unfinished(self) -> bool:
-        return bool(self._waiting) or any(
-            r is not None for r in self._slots
-        )
+        return self.sched.has_unfinished
 
     # ------------------------------------------------------------ scheduling
-    def _free_slot(self) -> int | None:
-        for i, r in enumerate(self._slots):
-            if r is None:
-                return i
-        return None
-
     def _admit(self) -> list[tuple[int, int]]:
-        """Move waiting requests into free slots while blocks allow.
+        """Admit waiting requests while the scheduler allows.
 
         Returns the first tokens emitted by prefill as (request_id, token).
+        A prefill may immediately finish its request (stop token /
+        max_tokens=1), freeing the slot for the next admission in the same
+        step — hence admission is incremental, not batch-planned.
         """
         emitted: list[tuple[int, int]] = []
-        while self._waiting:
-            slot = self._free_slot()
-            if slot is None:
-                break
-            request = self._waiting[0]
-            # Reserve room for all tokens so far plus one more (preempted
-            # requests re-prefill prompt + generated-so-far).
-            blocks = self.kv.allocate_sequence(request.num_tokens + 1)
-            if blocks is None:
-                if all(r is None for r in self._slots):
-                    raise RuntimeError(
-                        f'request {request.request_id} needs '
-                        f'{self.kv.blocks_needed(request.num_tokens + 1)} KV '
-                        f'blocks but only {self.kv.allocator.num_free} are '
-                        'free with no running requests to wait for; '
-                        'increase num_blocks'
-                    )
-                break
-            self._waiting.pop(0)
-            request.blocks = blocks
-            request.slot = slot
+        while (rid := self.sched.admit_next()) is not None:
+            request = self._requests[rid]
             request.state = RequestState.RUNNING
-            self._slots[slot] = request
-            emitted.append((request.request_id, self._run_prefill(request)))
+            emitted.append((rid, self._run_prefill(request)))
         return emitted
-
-    def _preempt_youngest(self) -> bool:
-        """Free the most recently admitted request back to waiting (recompute
-        preemption, vLLM-style)."""
-        candidates = [r for r in self._slots if r is not None]
-        if len(candidates) <= 1:
-            return False
-        victim = max(candidates, key=lambda r: r.request_id)
-        self.kv.free_sequence(victim.blocks)
-        self._slots[victim.slot] = None
-        victim.slot = None
-        # Recompute preemption: on re-admission the prefill re-runs over
-        # prompt + generated-so-far; output_ids stay intact so the final
-        # result and the max_tokens budget are unaffected.
-        victim.state = RequestState.WAITING
-        self._waiting.insert(0, victim)
-        return True
 
     # -------------------------------------------------------------- prefill
     def _run_prefill(self, request: Request) -> int:
@@ -250,7 +217,7 @@ class LLMEngine:
         mask[0, : len(prompt)] = 1
 
         logits_all, k_all, v_all = self._prefill(self.params, ids, mask)
-        block_row = self._block_row(request)
+        block_row = self._block_row(request.request_id)
         self.kv.k, self.kv.v = self._write_prefill(
             self.kv.k,
             self.kv.v,
@@ -265,40 +232,30 @@ class LLMEngine:
         self._emit_token(request, token)
         return token
 
-    def _block_row(self, request: Request) -> np.ndarray:
+    def _block_row(self, rid: int) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
-        row[: len(request.blocks)] = request.blocks
+        blocks = self.sched.block_row(rid)
+        row[: len(blocks)] = blocks
         return row
 
     # --------------------------------------------------------------- decode
     def step(self) -> list[tuple[int, int]]:
         """One engine iteration. Returns [(request_id, new_token)] emitted."""
         emitted = self._admit()
-        active = [r for r in self._slots if r is not None]
-        if not active:
+        if self.sched.num_running == 0:
             return emitted
 
-        # Ensure every active sequence has a block for its next token;
-        # preempt on OOM and retry once.
-        for request in list(active):
-            if request.slot is None:
-                continue  # preempted by an earlier iteration of this loop
-            preempted_self = False
-            while not self.kv.extend_sequence(
-                request.blocks, request.num_tokens + 1
-            ):
-                if not self._preempt_youngest():
-                    raise RuntimeError(
-                        'KV cache exhausted with a single running sequence; '
-                        'increase num_blocks or reduce max_model_len'
-                    )
-                if request.slot is None:  # preempted itself
-                    preempted_self = True
-                    break
-            if preempted_self:
-                continue
-        active = [r for r in self._slots if r is not None]
-        if not active:
+        # The scheduler guarantees every running sequence a block for its
+        # next token, preempting the youngest on OOM (recompute preemption:
+        # output_ids stay intact, so results and token budgets are
+        # unaffected; the request re-prefills on re-admission).
+        for rid in self.sched.prepare_decode():
+            self._requests[rid].state = RequestState.WAITING
+        # O(max_num_seqs) slot-table read, not a scan of every queued request.
+        running = [
+            (slot, self._requests[rid]) for slot, rid in self.sched.running()
+        ]
+        if not running:
             return emitted
 
         b = self.config.max_num_seqs
@@ -306,8 +263,8 @@ class LLMEngine:
         positions = np.zeros((b,), np.int32)
         block_tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
         context_lens = np.ones((b,), np.int32)
-        for request in active:
-            slot = request.slot
+        slot_requests: list[Request | None] = [None] * b
+        for slot, request in running:
             last = (
                 request.output_ids[-1]
                 if request.output_ids
@@ -315,8 +272,9 @@ class LLMEngine:
             )
             ids[slot] = last
             positions[slot] = request.num_tokens - 1
-            block_tables[slot] = self._block_row(request)
+            block_tables[slot] = self._block_row(request.request_id)
             context_lens[slot] = request.num_tokens
+            slot_requests[slot] = request
 
         logits, self.kv.k, self.kv.v = self._decode(
             self.params,
@@ -327,11 +285,9 @@ class LLMEngine:
             jnp.asarray(block_tables),
             jnp.asarray(context_lens),
         )
-        tokens = self._sample_batch(
-            logits, [self._slots[i] for i in range(b)]
-        )
-        for request in active:
-            token = int(tokens[request.slot])
+        tokens = self._sample_batch(logits, slot_requests)
+        for slot, request in running:
+            token = int(tokens[slot])
             self._emit_token(request, token)
             emitted.append((request.request_id, token))
         return emitted
@@ -362,6 +318,7 @@ class LLMEngine:
         # Note: the emitted token is NOT yet written to the KV cache; it is
         # fed as input on the next decode step, which writes it then.
         request.output_ids.append(token)
+        self.sched.append_token(request.request_id)
         eos = getattr(self.tokenizer, 'eos_id', None)
         stops = set(request.params.stop_token_ids)
         if eos is not None:
@@ -375,10 +332,8 @@ class LLMEngine:
 
     def _finish(self, request: Request) -> None:
         request.state = RequestState.FINISHED
-        self.kv.free_sequence(request.blocks)
-        if request.slot is not None:
-            self._slots[request.slot] = None
-            request.slot = None
+        self.sched.finish(request.request_id)
+        del self._requests[request.request_id]
         self._finished[request.request_id] = request
 
     # -------------------------------------------------------------- offline

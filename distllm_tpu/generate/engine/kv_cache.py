@@ -121,7 +121,12 @@ def make_allocator(num_blocks: int, prefer_native: bool = True) -> BlockAllocato
 
 
 class PagedKVCache:
-    """Device-resident paged K/V arrays plus per-sequence block bookkeeping."""
+    """Device-resident paged K/V arrays (pure container).
+
+    Block *accounting* — who owns which block, admission, preemption — is
+    the scheduler's job (``engine/scheduler.py`` over the native C++ core);
+    keeping a second free-list here would silently desync from it.
+    """
 
     def __init__(
         self,
@@ -131,46 +136,15 @@ class PagedKVCache:
         num_kv_heads: int,
         head_dim: int,
         dtype: str = 'bfloat16',
-        prefer_native_allocator: bool = True,
     ) -> None:
         shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
         self.k = jnp.zeros(shape, dtype=jnp.dtype(dtype))
         self.v = jnp.zeros(shape, dtype=jnp.dtype(dtype))
         self.block_size = block_size
         self.num_blocks = num_blocks
-        self.allocator = make_allocator(num_blocks, prefer_native_allocator)
 
     def blocks_needed(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
-
-    def can_allocate(self, num_tokens: int) -> bool:
-        return self.allocator.num_free >= self.blocks_needed(num_tokens)
-
-    def allocate_sequence(self, num_tokens: int) -> list[int] | None:
-        """Allocate blocks for a sequence; None if insufficient."""
-        needed = self.blocks_needed(num_tokens)
-        if self.allocator.num_free < needed:
-            return None
-        blocks = []
-        for _ in range(needed):
-            block_id = self.allocator.alloc()
-            assert block_id > 0
-            blocks.append(block_id)
-        return blocks
-
-    def extend_sequence(self, blocks: list[int], num_tokens: int) -> bool:
-        """Grow a sequence's block list to cover ``num_tokens``; False = OOM."""
-        while len(blocks) < self.blocks_needed(num_tokens):
-            block_id = self.allocator.alloc()
-            if block_id < 0:
-                return False
-            blocks.append(block_id)
-        return True
-
-    def free_sequence(self, blocks: list[int]) -> None:
-        for block_id in blocks:
-            self.allocator.free(block_id)
-        blocks.clear()
 
     @property
     def hbm_bytes(self) -> int:

@@ -1,0 +1,343 @@
+"""Continuous-batching scheduler: native C++ core with a Python twin.
+
+The policy layer of the generation engine (the analogue of vLLM's scheduler,
+SURVEY.md §2.4 N1) extracted behind one interface:
+
+- :class:`NativeScheduler` — ctypes binding over
+  ``distllm_tpu/native/scheduler.cpp``; owns the block free-list, slot
+  table, waiting queue, and preemption policy in C++.
+- :class:`PyScheduler` — pure-Python implementation of the identical
+  policy (fallback when no compiler is available; also the differential-
+  test oracle).
+
+Policy contract (both implementations, tested in lockstep):
+
+- ``admit_next`` pops the waiting-queue head into the lowest free slot when
+  blocks for ``num_tokens + 1`` are available (all-or-nothing).
+- ``prepare_decode`` guarantees every running sequence can take one more
+  token, preempting the youngest (highest rid) on OOM — recompute
+  preemption: blocks freed, request to the FRONT of the waiting queue.
+- Block 0 is the reserved trash block and is never allocated.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Protocol
+
+
+class SchedulerExhausted(RuntimeError):
+    """The block pool cannot serve even a lone request; raise to the caller."""
+
+
+class Scheduler(Protocol):
+    def add(self, rid: int, num_tokens: int) -> None: ...
+
+    def admit_next(self) -> int | None: ...
+
+    def prepare_decode(self) -> list[int]: ...
+
+    def append_token(self, rid: int) -> None: ...
+
+    def finish(self, rid: int) -> None: ...
+
+    def slot(self, rid: int) -> int: ...
+
+    def running(self) -> list[tuple[int, int]]: ...
+
+    def block_row(self, rid: int) -> list[int]: ...
+
+    @property
+    def num_free_blocks(self) -> int: ...
+
+    @property
+    def num_running(self) -> int: ...
+
+    @property
+    def num_waiting(self) -> int: ...
+
+    @property
+    def has_unfinished(self) -> bool: ...
+
+
+@dataclass
+class _PyRequest:
+    rid: int
+    num_tokens: int
+    blocks: list[int] = field(default_factory=list)
+    slot: int = -1
+
+
+class PyScheduler:
+    """Pure-Python scheduler (same observable policy as the C++ core)."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_num_seqs: int) -> None:
+        if num_blocks < 2:
+            raise ValueError('need >= 2 blocks (block 0 is reserved)')
+        self._block_size = block_size
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._waiting: deque[int] = deque()
+        self._slots: list[int] = [-1] * max_num_seqs
+        self._requests: dict[int, _PyRequest] = {}
+
+    def _blocks_needed(self, tokens: int) -> int:
+        return (tokens + self._block_size - 1) // self._block_size
+
+    def add(self, rid: int, num_tokens: int) -> None:
+        if rid in self._requests:
+            raise ValueError(f'duplicate request id {rid}')
+        self._requests[rid] = _PyRequest(rid, num_tokens)
+        self._waiting.append(rid)
+
+    def admit_next(self) -> int | None:
+        if not self._waiting:
+            return None
+        try:
+            slot = self._slots.index(-1)
+        except ValueError:
+            return None
+        rid = self._waiting[0]
+        req = self._requests[rid]
+        needed = self._blocks_needed(req.num_tokens + 1)
+        if needed > len(self._free):
+            if self.num_running == 0:
+                raise SchedulerExhausted(
+                    f'request {rid} needs {needed} KV blocks but only '
+                    f'{len(self._free)} are free with nothing running; '
+                    'increase num_blocks'
+                )
+            return None
+        self._waiting.popleft()
+        req.blocks = [self._free.pop() for _ in range(needed)]
+        req.slot = slot
+        self._slots[slot] = rid
+        return rid
+
+    def _preempt_youngest(self) -> int | None:
+        running = [r for r in self._slots if r >= 0]
+        if len(running) <= 1:
+            return None
+        victim = self._requests[max(running)]
+        self._free.extend(victim.blocks)
+        victim.blocks = []
+        self._slots[victim.slot] = -1
+        victim.slot = -1
+        self._waiting.appendleft(victim.rid)
+        return victim.rid
+
+    def _extend(self, req: _PyRequest, tokens: int) -> bool:
+        while len(req.blocks) < self._blocks_needed(tokens):
+            if not self._free:
+                return False
+            req.blocks.append(self._free.pop())
+        return True
+
+    def prepare_decode(self) -> list[int]:
+        preempted: list[int] = []
+        for rid in list(self._slots):
+            if rid < 0:
+                continue
+            req = self._requests[rid]
+            if req.slot < 0:
+                continue  # preempted earlier in this loop
+            while not self._extend(req, req.num_tokens + 1):
+                victim = self._preempt_youngest()
+                if victim is None:
+                    raise SchedulerExhausted(
+                        'KV cache exhausted with a single running sequence; '
+                        'increase num_blocks or reduce max_model_len'
+                    )
+                preempted.append(victim)
+                if victim == rid:
+                    break
+        return preempted
+
+    def append_token(self, rid: int) -> None:
+        self._requests[rid].num_tokens += 1
+
+    def finish(self, rid: int) -> None:
+        req = self._requests.pop(rid)
+        self._free.extend(req.blocks)
+        if req.slot >= 0:
+            self._slots[req.slot] = -1
+        try:
+            self._waiting.remove(rid)
+        except ValueError:
+            pass
+
+    def slot(self, rid: int) -> int:
+        return self._requests[rid].slot
+
+    def running(self) -> list[tuple[int, int]]:
+        """Occupied ``(slot, rid)`` pairs in slot order — O(max_num_seqs)."""
+        return [(i, rid) for i, rid in enumerate(self._slots) if rid >= 0]
+
+    def block_row(self, rid: int) -> list[int]:
+        return list(self._requests[rid].blocks)
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_running(self) -> int:
+        return sum(1 for r in self._slots if r >= 0)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def has_unfinished(self) -> bool:
+        return bool(self._waiting) or self.num_running > 0
+
+
+class NativeScheduler:
+    """ctypes binding over the C++ scheduler core."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_num_seqs: int) -> None:
+        from distllm_tpu.native import build_library
+
+        so_path = build_library('scheduler.cpp')
+        if so_path is None:
+            raise RuntimeError('native scheduler unavailable')
+        lib = ctypes.CDLL(str(so_path))
+        lib.sched_create.restype = ctypes.c_void_p
+        lib.sched_create.argtypes = [ctypes.c_int32] * 3
+        lib.sched_destroy.argtypes = [ctypes.c_void_p]
+        lib.sched_add.restype = ctypes.c_int32
+        lib.sched_add.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
+        lib.sched_admit_next.restype = ctypes.c_int64
+        lib.sched_admit_next.argtypes = [ctypes.c_void_p]
+        lib.sched_prepare_decode.restype = ctypes.c_int32
+        lib.sched_prepare_decode.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        for name in ('sched_append_token', 'sched_finish'):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int32
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sched_slot.restype = ctypes.c_int32
+        lib.sched_slot.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sched_running.restype = ctypes.c_int32
+        lib.sched_running.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.sched_block_row.restype = ctypes.c_int32
+        lib.sched_block_row.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        for name in (
+            'sched_num_free',
+            'sched_num_running',
+            'sched_num_waiting',
+            'sched_has_unfinished',
+        ):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int32
+            fn.argtypes = [ctypes.c_void_p]
+
+        handle = lib.sched_create(num_blocks, block_size, max_num_seqs)
+        if not handle:
+            raise RuntimeError(
+                f'sched_create({num_blocks}, {block_size}, {max_num_seqs}) failed'
+            )
+        self._lib = lib
+        self._handle = handle
+        self._max_num_seqs = max_num_seqs
+        self._num_blocks = num_blocks
+
+    def add(self, rid: int, num_tokens: int) -> None:
+        rc = self._lib.sched_add(self._handle, rid, num_tokens)
+        if rc == -2:
+            raise ValueError(f'duplicate request id {rid}')
+        if rc != 0:
+            raise RuntimeError(f'sched_add failed: {rc}')
+
+    def admit_next(self) -> int | None:
+        rid = int(self._lib.sched_admit_next(self._handle))
+        if rid == -2:
+            raise SchedulerExhausted(
+                'request needs more KV blocks than are free with nothing '
+                'running; increase num_blocks'
+            )
+        return None if rid < 0 else rid
+
+    def prepare_decode(self) -> list[int]:
+        out = (ctypes.c_int64 * self._max_num_seqs)()
+        n = int(self._lib.sched_prepare_decode(self._handle, out))
+        if n < 0:
+            raise SchedulerExhausted(
+                'KV cache exhausted with a single running sequence; '
+                'increase num_blocks or reduce max_model_len'
+            )
+        return [int(out[i]) for i in range(n)]
+
+    def append_token(self, rid: int) -> None:
+        if self._lib.sched_append_token(self._handle, rid) != 0:
+            raise KeyError(rid)
+
+    def finish(self, rid: int) -> None:
+        if self._lib.sched_finish(self._handle, rid) != 0:
+            raise KeyError(rid)
+
+    def slot(self, rid: int) -> int:
+        return int(self._lib.sched_slot(self._handle, rid))
+
+    def running(self) -> list[tuple[int, int]]:
+        slots = (ctypes.c_int32 * self._max_num_seqs)()
+        rids = (ctypes.c_int64 * self._max_num_seqs)()
+        n = int(self._lib.sched_running(self._handle, slots, rids))
+        return [(int(slots[i]), int(rids[i])) for i in range(n)]
+
+    def block_row(self, rid: int) -> list[int]:
+        out = (ctypes.c_int32 * self._num_blocks)()
+        n = int(self._lib.sched_block_row(self._handle, rid, out, self._num_blocks))
+        if n < 0:
+            raise KeyError(rid)
+        return [int(out[i]) for i in range(n)]
+
+    @property
+    def num_free_blocks(self) -> int:
+        return int(self._lib.sched_num_free(self._handle))
+
+    @property
+    def num_running(self) -> int:
+        return int(self._lib.sched_num_running(self._handle))
+
+    @property
+    def num_waiting(self) -> int:
+        return int(self._lib.sched_num_waiting(self._handle))
+
+    @property
+    def has_unfinished(self) -> bool:
+        return bool(self._lib.sched_has_unfinished(self._handle))
+
+    def __del__(self) -> None:  # pragma: no cover - interpreter teardown
+        lib = getattr(self, '_lib', None)
+        handle = getattr(self, '_handle', None)
+        if lib is not None and handle:
+            lib.sched_destroy(handle)
+            self._handle = None
+
+
+def make_scheduler(
+    num_blocks: int,
+    block_size: int,
+    max_num_seqs: int,
+    prefer_native: bool = True,
+) -> Scheduler:
+    if prefer_native:
+        try:
+            return NativeScheduler(num_blocks, block_size, max_num_seqs)
+        except (RuntimeError, OSError):
+            pass
+    return PyScheduler(num_blocks, block_size, max_num_seqs)

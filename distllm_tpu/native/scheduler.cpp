@@ -1,0 +1,260 @@
+// Continuous-batching scheduler — native runtime core of the generation
+// engine (the TPU analogue of vLLM's scheduler; SURVEY.md §2.4 N1).
+//
+// Owns ALL scheduling state: the block free-list, per-request block lists,
+// slot assignment, the waiting queue, and the admission / recompute-
+// preemption policy. The Python engine asks it what to do each step and
+// only runs the jitted device programs. A pure-Python twin
+// (engine/scheduler.py PyScheduler) implements the identical policy;
+// differential tests drive both with the same workload and require
+// identical decisions.
+//
+// Policy (must stay in lockstep with PyScheduler):
+//   - admit_next: pop the head of the waiting queue into the lowest free
+//     slot if blocks for (num_tokens + 1) are available.
+//   - prepare_decode: every running sequence gets capacity for one more
+//     token; on OOM, preempt the youngest (highest request id) running
+//     request — free its blocks, push it to the FRONT of the waiting
+//     queue (recompute preemption: it will re-prefill prompt + generated).
+//   - block 0 is the reserved trash block and is never handed out.
+//
+// C ABI for ctypes; no exceptions across the boundary.
+
+#include <algorithm>
+#include <cstdint>
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Request {
+    int64_t rid;
+    int32_t num_tokens;  // prompt + generated so far
+    std::vector<int32_t> blocks;
+    int32_t slot = -1;  // -1 = not running
+};
+
+struct Scheduler {
+    int32_t block_size;
+    std::vector<int32_t> free_list;  // LIFO of free block ids (block 0 reserved)
+    std::deque<int64_t> waiting;
+    std::vector<int64_t> slots;  // slot -> rid, -1 empty
+    std::unordered_map<int64_t, Request> requests;
+
+    Scheduler(int32_t num_blocks, int32_t block_size_, int32_t max_num_seqs)
+        : block_size(block_size_), slots(max_num_seqs, -1) {
+        free_list.reserve(num_blocks > 0 ? num_blocks - 1 : 0);
+        for (int32_t i = num_blocks - 1; i >= 1; --i) free_list.push_back(i);
+    }
+
+    int32_t blocks_needed(int32_t tokens) const {
+        return (tokens + block_size - 1) / block_size;
+    }
+
+    int32_t num_free() const {
+        return static_cast<int32_t>(free_list.size());
+    }
+
+    int32_t alloc_block() {
+        if (free_list.empty()) return -1;
+        int32_t b = free_list.back();
+        free_list.pop_back();
+        return b;
+    }
+
+    void free_request_blocks(Request& req) {
+        for (int32_t b : req.blocks) free_list.push_back(b);
+        req.blocks.clear();
+    }
+
+    int32_t free_slot() const {
+        for (size_t i = 0; i < slots.size(); ++i)
+            if (slots[i] < 0) return static_cast<int32_t>(i);
+        return -1;
+    }
+
+    int32_t num_running() const {
+        int32_t n = 0;
+        for (int64_t rid : slots) n += (rid >= 0);
+        return n;
+    }
+
+    // Grow req.blocks to cover `tokens`; false = pool dry (partial growth
+    // is kept — the caller retries after preempting someone).
+    bool extend(Request& req, int32_t tokens) {
+        while (static_cast<int32_t>(req.blocks.size()) < blocks_needed(tokens)) {
+            int32_t b = alloc_block();
+            if (b < 0) return false;
+            req.blocks.push_back(b);
+        }
+        return true;
+    }
+
+    // Preempt the youngest (max rid) running request. Returns its rid, or
+    // -1 when fewer than two are running (never preempt the only one).
+    int64_t preempt_youngest() {
+        int64_t victim = -1;
+        int32_t count = 0;
+        for (int64_t rid : slots) {
+            if (rid < 0) continue;
+            ++count;
+            victim = std::max(victim, rid);
+        }
+        if (count <= 1) return -1;
+        Request& req = requests[victim];
+        free_request_blocks(req);
+        slots[req.slot] = -1;
+        req.slot = -1;
+        waiting.push_front(victim);
+        return victim;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sched_create(int32_t num_blocks, int32_t block_size,
+                   int32_t max_num_seqs) {
+    if (num_blocks < 2 || block_size < 1 || max_num_seqs < 1) return nullptr;
+    return new Scheduler(num_blocks, block_size, max_num_seqs);
+}
+
+void sched_destroy(void* h) { delete static_cast<Scheduler*>(h); }
+
+// Enqueue a request with `num_tokens` tokens to recompute (prompt, plus any
+// generated tokens when re-adding after an external preemption). Returns 0,
+// or -1 if it can never fit even in an empty pool.
+int32_t sched_add(void* h, int64_t rid, int32_t num_tokens) {
+    auto* s = static_cast<Scheduler*>(h);
+    if (s->requests.count(rid)) return -2;
+    Request req;
+    req.rid = rid;
+    req.num_tokens = num_tokens;
+    s->requests.emplace(rid, std::move(req));
+    s->waiting.push_back(rid);
+    return 0;
+}
+
+// Admit the head of the waiting queue: assign the lowest free slot and
+// allocate blocks for num_tokens + 1. Returns the admitted rid, -1 when
+// nothing can be admitted right now, or -2 when the head request cannot get
+// blocks while NOTHING is running (caller should raise: pool too small).
+int64_t sched_admit_next(void* h) {
+    auto* s = static_cast<Scheduler*>(h);
+    if (s->waiting.empty()) return -1;
+    int32_t slot = s->free_slot();
+    if (slot < 0) return -1;
+    int64_t rid = s->waiting.front();
+    Request& req = s->requests[rid];
+    int32_t needed = s->blocks_needed(req.num_tokens + 1);
+    if (needed > s->num_free()) {
+        return s->num_running() == 0 ? -2 : -1;
+    }
+    s->waiting.pop_front();
+    for (int32_t i = 0; i < needed; ++i) req.blocks.push_back(s->alloc_block());
+    req.slot = slot;
+    s->slots[slot] = rid;
+    return rid;
+}
+
+// Ensure every running sequence has block capacity for one more token,
+// preempting the youngest on OOM. Preempted rids are written to
+// out_preempted (capacity = max_num_seqs). Returns the preempted count, or
+// -1 when the pool is exhausted with a single running sequence (fatal).
+int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {
+    auto* s = static_cast<Scheduler*>(h);
+    int32_t n_preempted = 0;
+    std::vector<int64_t> snapshot(s->slots);
+    for (int64_t rid : snapshot) {
+        if (rid < 0) continue;
+        Request& req = s->requests[rid];
+        if (req.slot < 0) continue;  // preempted earlier in this loop
+        bool preempted_self = false;
+        while (!s->extend(req, req.num_tokens + 1)) {
+            int64_t victim = s->preempt_youngest();
+            if (victim < 0) return -1;
+            out_preempted[n_preempted++] = victim;
+            if (victim == rid) {
+                preempted_self = true;
+                break;
+            }
+        }
+        if (preempted_self) continue;
+    }
+    return n_preempted;
+}
+
+int32_t sched_append_token(void* h, int64_t rid) {
+    auto* s = static_cast<Scheduler*>(h);
+    auto it = s->requests.find(rid);
+    if (it == s->requests.end()) return -1;
+    it->second.num_tokens += 1;
+    return 0;
+}
+
+// Finish (or cancel) a request: free blocks, release the slot, drop state.
+int32_t sched_finish(void* h, int64_t rid) {
+    auto* s = static_cast<Scheduler*>(h);
+    auto it = s->requests.find(rid);
+    if (it == s->requests.end()) return -1;
+    Request& req = it->second;
+    s->free_request_blocks(req);
+    if (req.slot >= 0) s->slots[req.slot] = -1;
+    auto w = std::find(s->waiting.begin(), s->waiting.end(), rid);
+    if (w != s->waiting.end()) s->waiting.erase(w);
+    s->requests.erase(it);
+    return 0;
+}
+
+int32_t sched_slot(void* h, int64_t rid) {
+    auto* s = static_cast<Scheduler*>(h);
+    auto it = s->requests.find(rid);
+    return it == s->requests.end() ? -1 : it->second.slot;
+}
+
+// Write the request's block ids into out (capacity cap); returns the count
+// actually owned, or -1 for an unknown rid.
+int32_t sched_block_row(void* h, int64_t rid, int32_t* out, int32_t cap) {
+    auto* s = static_cast<Scheduler*>(h);
+    auto it = s->requests.find(rid);
+    if (it == s->requests.end()) return -1;
+    const auto& blocks = it->second.blocks;
+    int32_t n = static_cast<int32_t>(blocks.size());
+    for (int32_t i = 0; i < n && i < cap; ++i) out[i] = blocks[i];
+    return n;
+}
+
+// Write the slot table's occupied entries as (slot, rid) pairs; returns the
+// count. out_slots/out_rids capacity must be max_num_seqs.
+int32_t sched_running(void* h, int32_t* out_slots, int64_t* out_rids) {
+    auto* s = static_cast<Scheduler*>(h);
+    int32_t n = 0;
+    for (size_t i = 0; i < s->slots.size(); ++i) {
+        if (s->slots[i] < 0) continue;
+        out_slots[n] = static_cast<int32_t>(i);
+        out_rids[n] = s->slots[i];
+        ++n;
+    }
+    return n;
+}
+
+int32_t sched_num_free(void* h) {
+    return static_cast<Scheduler*>(h)->num_free();
+}
+
+int32_t sched_num_running(void* h) {
+    return static_cast<Scheduler*>(h)->num_running();
+}
+
+int32_t sched_num_waiting(void* h) {
+    return static_cast<int32_t>(static_cast<Scheduler*>(h)->waiting.size());
+}
+
+int32_t sched_has_unfinished(void* h) {
+    auto* s = static_cast<Scheduler*>(h);
+    return (!s->waiting.empty() || s->num_running() > 0) ? 1 : 0;
+}
+
+}  // extern "C"

@@ -72,6 +72,40 @@ class BaseConfig(BaseModel):
             fh.write(self.model_dump_json(indent=2))
 
 
+def instantiate(config: Any, **overrides: Any) -> Any:
+    """``_target_``-field class dispatch (reference ``chat_argoproxy.py:511-549``).
+
+    A dict carrying ``_target_: 'pkg.module.ClassName'`` is resolved by
+    import and constructed from the remaining keys; nested dicts instantiate
+    recursively (depth-first), and ``${env:VAR}`` markers substitute first.
+    Non-``_target_`` values pass through unchanged.
+    """
+    config = _substitute_env(config)
+
+    def build(obj: Any) -> Any:
+        if isinstance(obj, dict):
+            built = {k: build(v) for k, v in obj.items() if k != '_target_'}
+            target = obj.get('_target_')
+            if target is None:
+                return built
+            import importlib
+
+            module_name, _, attr = str(target).rpartition('.')
+            if not module_name:
+                raise ValueError(
+                    f"_target_ must be a dotted path, got {target!r}"
+                )
+            cls = getattr(importlib.import_module(module_name), attr)
+            return cls(**built)
+        if isinstance(obj, list):
+            return [build(v) for v in obj]
+        return obj
+
+    if isinstance(config, dict):
+        config = {**config, **overrides}
+    return build(config)
+
+
 def batch_data(data: list[T], batch_size: int) -> list[list[T]]:
     """Split ``data`` into consecutive chunks of at most ``batch_size``.
 

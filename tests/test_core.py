@@ -149,3 +149,44 @@ def test_expo_backoff_retry():
         expo_backoff_retry(
             fatal, give_up_on=(AuthError,), sleep=lambda _: None
         )
+
+
+class TestInstantiate:
+    """``_target_`` class dispatch (reference ``chat_argoproxy.py:511-549``)."""
+
+    def test_target_dispatch(self):
+        from distllm_tpu.utils import instantiate
+
+        obj = instantiate(
+            {'_target_': 'pathlib.PurePosixPath', 'args': None}
+            | {'_target_': 'collections.Counter'}
+        )
+        import collections
+
+        assert isinstance(obj, collections.Counter)
+
+    def test_nested_and_env(self, monkeypatch):
+        from distllm_tpu.utils import instantiate
+
+        monkeypatch.setenv('VFY_NAME', 'hello')
+        out = instantiate(
+            {
+                'inner': {'_target_': 'fractions.Fraction', 'numerator': 3},
+                'plain': '${env:VFY_NAME}',
+            }
+        )
+        import fractions
+
+        assert out['inner'] == fractions.Fraction(3)
+        assert out['plain'] == 'hello'
+
+    def test_bad_target_raises(self):
+        from distllm_tpu.utils import instantiate
+
+        with pytest.raises(ValueError, match='dotted path'):
+            instantiate({'_target_': 'NoDots'})
+
+    def test_passthrough(self):
+        from distllm_tpu.utils import instantiate
+
+        assert instantiate({'a': [1, 2]}) == {'a': [1, 2]}

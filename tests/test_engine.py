@@ -172,17 +172,15 @@ def test_block_allocator(cls):
         alloc.free(blocks[0])  # double free
 
 
-def test_paged_kv_cache_bookkeeping():
+def test_paged_kv_cache_container():
+    """Pure device-array container (block accounting lives in the scheduler)."""
     kv = PagedKVCache(
         num_layers=2, num_blocks=8, block_size=4, num_kv_heads=2,
-        head_dim=4, dtype='float32', prefer_native_allocator=False,
+        head_dim=4, dtype='float32',
     )
-    blocks = kv.allocate_sequence(10)  # 3 blocks
-    assert len(blocks) == 3
-    assert kv.extend_sequence(blocks, 13)  # 4th block
-    assert len(blocks) == 4
-    kv.free_sequence(blocks)
-    assert kv.allocator.num_free == 7
+    assert kv.k.shape == (2, 8, 4, 2, 4)
+    assert kv.blocks_needed(10) == 3
+    assert kv.hbm_bytes == 2 * 2 * 8 * 4 * 2 * 4 * 4
 
 
 # ----------------------------------------------------------------- engine
@@ -258,7 +256,7 @@ def test_engine_continuous_batching_join_leave():
     assert len(seen[r2]) == 2
     assert len(seen[r3]) == 2
     # all finished requests got their outputs recorded & slots/blocks freed
-    assert all(r is None for r in engine._slots)
+    assert engine.sched.num_running == 0
     ref = _dense_greedy_reference(cfg, params, [5, 6, 7], 6)
     assert seen[r1] == ref
 
@@ -275,7 +273,7 @@ def test_engine_preemption_under_block_pressure():
         ref = _dense_greedy_reference(cfg, params, prompt, 6)
         assert out == ref
     # No block leaks: everything freed at the end.
-    assert engine.kv.allocator.num_free == 7
+    assert engine.sched.num_free_blocks == 7
 
 
 def test_engine_prompt_at_max_model_len():

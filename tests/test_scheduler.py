@@ -1,0 +1,188 @@
+"""Native C++ scheduler vs the Python twin: identical decisions.
+
+The continuous-batching policy (admission, block budget, recompute
+preemption — the role vLLM's scheduler plays for the reference,
+SURVEY.md §2.4 N1) ships as a C++ core with a Python oracle; these tests
+drive both with the same workloads and require decision-for-decision
+equality, then exercise the policy edges on either implementation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distllm_tpu.generate.engine.scheduler import (
+    NativeScheduler,
+    PyScheduler,
+    SchedulerExhausted,
+    make_scheduler,
+)
+
+
+def native_available() -> bool:
+    try:
+        NativeScheduler(8, 4, 2)
+        return True
+    except (RuntimeError, OSError):
+        return False
+
+
+requires_native = pytest.mark.skipif(
+    not native_available(), reason='no C++ toolchain'
+)
+
+
+def drive(sched, seed: int, steps: int = 200):
+    """Random workload driver; returns the full decision trace."""
+    rng = np.random.default_rng(seed)
+    trace = []
+    next_rid = 0
+    live: set[int] = set()
+    for _ in range(steps):
+        action = rng.integers(0, 4)
+        if action == 0 or not live:
+            tokens = int(rng.integers(1, 40))
+            sched.add(next_rid, tokens)
+            live.add(next_rid)
+            trace.append(('add', next_rid, tokens))
+            next_rid += 1
+        elif action == 1:
+            admitted = []
+            try:
+                while (rid := sched.admit_next()) is not None:
+                    admitted.append(rid)
+            except SchedulerExhausted:
+                admitted.append('EXHAUSTED')
+            trace.append(('admit', tuple(admitted)))
+        elif action == 2:
+            if sched.num_running:
+                try:
+                    preempted = sched.prepare_decode()
+                except SchedulerExhausted:
+                    preempted = ['EXHAUSTED']
+                trace.append(('prepare', tuple(preempted)))
+                for rid in list(live):
+                    if sched.slot(rid) >= 0:
+                        sched.append_token(rid)
+                        trace.append(('token', rid))
+        else:
+            running = [rid for rid in live if sched.slot(rid) >= 0]
+            if running:
+                rid = running[int(rng.integers(0, len(running)))]
+                sched.finish(rid)
+                live.discard(rid)
+                trace.append(('finish', rid))
+        trace.append(
+            ('state', sched.num_free_blocks, sched.num_running, sched.num_waiting)
+        )
+    # Block rows of everything still live (allocation order must agree too).
+    for rid in sorted(live):
+        trace.append(('blocks', rid, tuple(sched.block_row(rid))))
+    return trace
+
+
+@requires_native
+@pytest.mark.parametrize('seed', [0, 1, 2, 3, 4])
+def test_native_matches_python_oracle(seed):
+    py = PyScheduler(num_blocks=24, block_size=4, max_num_seqs=3)
+    cc = NativeScheduler(num_blocks=24, block_size=4, max_num_seqs=3)
+    assert drive(cc, seed) == drive(py, seed)
+
+
+@requires_native
+def test_make_scheduler_prefers_native():
+    sched = make_scheduler(16, 4, 2, prefer_native=True)
+    assert isinstance(sched, NativeScheduler)
+
+
+@pytest.fixture(params=['py', 'native'])
+def sched_factory(request):
+    if request.param == 'native' and not native_available():
+        pytest.skip('no C++ toolchain')
+    cls = PyScheduler if request.param == 'py' else NativeScheduler
+
+    def make(num_blocks=16, block_size=4, max_num_seqs=2):
+        return cls(num_blocks, block_size, max_num_seqs)
+
+    return make
+
+
+class TestPolicy:
+    def test_admission_assigns_lowest_slot_and_blocks(self, sched_factory):
+        s = sched_factory()
+        s.add(0, 5)  # needs ceil(6/4) = 2 blocks
+        assert s.admit_next() == 0
+        assert s.slot(0) == 0
+        assert len(s.block_row(0)) == 2
+        assert s.num_free_blocks == 15 - 2
+        assert s.admit_next() is None
+
+    def test_admission_blocked_until_slot_frees(self, sched_factory):
+        s = sched_factory(max_num_seqs=1)
+        s.add(0, 3)
+        s.add(1, 3)
+        assert s.admit_next() == 0
+        assert s.admit_next() is None  # no slot
+        s.finish(0)
+        assert s.admit_next() == 1
+
+    def test_preemption_frees_youngest_to_waiting_front(self, sched_factory):
+        # 7 usable blocks, block_size 1: two sequences of 3 fit, then the
+        # older one's growth preempts the younger.
+        s = sched_factory(num_blocks=8, block_size=1, max_num_seqs=2)
+        s.add(0, 3)
+        s.add(1, 3)
+        assert s.admit_next() == 0  # takes 4 blocks (3 tokens + 1 headroom)
+        assert s.admit_next() is None  # rid 1 needs 4, only 3 free
+        assert s.slot(1) == -1
+        assert s.num_waiting == 1
+        # grow rid 0 to fill the pool, then prepare_decode keeps it running
+        for _ in range(3):
+            s.append_token(0)
+            assert s.prepare_decode() == []
+        assert s.num_free_blocks == 0
+
+    def test_preemption_round_trip(self, sched_factory):
+        s = sched_factory(num_blocks=9, block_size=1, max_num_seqs=2)
+        s.add(0, 3)
+        s.add(1, 3)
+        assert s.admit_next() == 0
+        assert s.admit_next() == 1
+        assert s.num_free_blocks == 0
+        s.append_token(0)  # rid 0 now needs a 5th block
+        preempted = s.prepare_decode()
+        assert preempted == [1]
+        assert s.slot(1) == -1
+        assert s.num_waiting == 1
+        assert s.block_row(1) == []
+        # rid 1 re-admits once rid 0 finishes, with tokens intact
+        s.finish(0)
+        assert s.admit_next() == 1
+        assert len(s.block_row(1)) == 4  # 3 tokens + 1 headroom
+
+    def test_exhausted_single_sequence_raises(self, sched_factory):
+        s = sched_factory(num_blocks=4, block_size=1, max_num_seqs=2)
+        s.add(0, 2)
+        assert s.admit_next() == 0  # takes all 3 usable blocks (2+1)
+        s.append_token(0)
+        with pytest.raises(SchedulerExhausted):
+            s.prepare_decode()  # needs a 4th block, pool has 3 usable
+
+    def test_admit_impossible_request_raises(self, sched_factory):
+        s = sched_factory(num_blocks=4, block_size=1, max_num_seqs=2)
+        s.add(0, 10)
+        with pytest.raises(SchedulerExhausted):
+            s.admit_next()
+
+    def test_duplicate_rid_rejected(self, sched_factory):
+        s = sched_factory()
+        s.add(0, 1)
+        with pytest.raises(ValueError):
+            s.add(0, 1)
+
+    def test_finish_waiting_request(self, sched_factory):
+        s = sched_factory()
+        s.add(0, 1)
+        s.finish(0)
+        assert not s.has_unfinished
