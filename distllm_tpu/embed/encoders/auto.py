@@ -16,7 +16,7 @@ from typing import Literal
 from pydantic import Field
 
 from distllm_tpu.embed.encoders.base import JaxEncoder
-from distllm_tpu.models import bert, esm2, mistral
+from distllm_tpu.models import bert, esm2, mistral, mixtral
 from distllm_tpu.models.loader import read_checkpoint, read_hf_config
 from distllm_tpu.models.tokenizer import HFTokenizer
 from distllm_tpu.utils import BaseConfig
@@ -25,6 +25,7 @@ _FAMILIES = {
     'bert': (bert.BertConfig, bert),
     'mistral': (mistral.MistralConfig, mistral),
     'llama': (mistral.MistralConfig, mistral),
+    'mixtral': (mixtral.MixtralConfig, mixtral),
     'esm': (esm2.Esm2Config, esm2),
 }
 

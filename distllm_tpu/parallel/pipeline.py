@@ -1,0 +1,150 @@
+"""Pipeline parallelism: GPipe-style microbatched stage loop over a mesh axis.
+
+The reference only ever *forwards a config knob* for pipeline parallelism to
+vLLM and never exercises it (``pipeline_parallel_size: 1`` in
+``examples/miscellaneous/multi_gpu_batch_config.yaml``; SURVEY.md §2.5).
+Here it is a real construction: the stacked layer pytree ``[L, ...]`` is
+sharded over a ``pipe`` mesh axis (each stage holds ``L / P`` layers), the
+batch is split into microbatches, and activations flow stage-to-stage with
+``lax.ppermute`` in the classic ``M + P - 1``-step schedule. Autodiff works
+through the permutes, so the same function serves training (GPipe backward)
+under ``jax.grad``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+PIPE_AXIS = 'pipe'
+
+
+def make_pipeline_mesh(num_stages: int, *, devices=None) -> Mesh:
+    """1-axis ``pipe`` mesh over the first ``num_stages`` devices."""
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < num_stages:
+        raise ValueError(
+            f'need {num_stages} devices for {num_stages} stages, '
+            f'have {len(devices)}'
+        )
+    return Mesh(np.asarray(devices[:num_stages]), (PIPE_AXIS,))
+
+
+def _stage_specs(params, axis: str):
+    """Leading-dim sharding spec for every leaf of the stacked layer pytree."""
+    return jax.tree_util.tree_map(
+        lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), params
+    )
+
+
+def _pipeline_local(
+    stage_params,
+    x_microbatches,  # [M, mb, ...] replicated input
+    *,
+    axis_name: str,
+    layer_fn: Callable,
+    num_microbatches: int,
+):
+    """Per-stage body (under shard_map).
+
+    ``stage_params`` holds this stage's ``L/P`` stacked layers; each stage
+    applies them with an inner ``lax.scan``. The outer ``fori_loop`` runs the
+    ``M + P - 1`` schedule; stage 0 feeds microbatch ``t`` at step ``t``, the
+    last stage collects its result at step ``t + P - 1``.
+    """
+    p_size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = num_microbatches
+    mb_shape = x_microbatches.shape[1:]
+
+    def apply_stage(x):
+        def body(x, lp):
+            return layer_fn(lp, x), None
+
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    out_buf = jnp.zeros((m,) + mb_shape, x_microbatches.dtype)
+    state = jnp.zeros(mb_shape, x_microbatches.dtype)
+    perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    def step(t, carry):
+        state, out_buf = carry
+        # Stage 0 ingests microbatch t (clamped; masked out when t >= M).
+        feed = x_microbatches[jnp.minimum(t, m - 1)]
+        inp = jnp.where(idx == 0, feed, state)
+        out = apply_stage(inp)
+        # The last stage finished microbatch (t - P + 1) at this step.
+        done = t - (p_size - 1)
+        collect = (idx == p_size - 1) & (done >= 0) & (done < m)
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf,
+            jnp.where(collect, out, out_buf[jnp.clip(done, 0, m - 1)]),
+            jnp.clip(done, 0, m - 1),
+            axis=0,
+        )
+        # Hand activations to the next stage (ring permute; the wraparound
+        # last->0 link carries garbage that stage 0 overwrites with `feed`).
+        state = lax.ppermute(out, axis_name, perm)
+        return state, out_buf
+
+    _, out_buf = lax.fori_loop(
+        0, m + p_size - 1, step, (state, out_buf)
+    )
+    # Only the last stage's buffer is real; psum broadcasts it (other
+    # stages contribute zeros).
+    out_buf = jnp.where(idx == p_size - 1, out_buf, jnp.zeros_like(out_buf))
+    return lax.psum(out_buf, axis_name)
+
+
+def pipeline_apply(
+    stacked_params,
+    x: jnp.ndarray,  # [B, ...]
+    layer_fn: Callable,  # (layer_params, x) -> x
+    mesh: Mesh,
+    *,
+    num_microbatches: int = 4,
+    axis: str = PIPE_AXIS,
+):
+    """Apply an ``[L, ...]``-stacked layer pytree as a ``P``-stage pipeline.
+
+    Equivalent to ``lax.scan(layer_fn, x, stacked_params)`` over the full
+    stack, but with layers stage-sharded over ``mesh``'s ``axis`` and the
+    batch pipelined in ``num_microbatches`` microbatches. ``B`` must divide
+    by ``num_microbatches``, ``L`` by the stage count.
+    """
+    p_size = mesh.shape[axis]
+    num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if num_layers % p_size != 0:
+        raise ValueError(
+            f'{num_layers} layers not divisible by {p_size} pipeline stages'
+        )
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(
+            f'batch {b} not divisible by {num_microbatches} microbatches'
+        )
+    x_mb = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+    fn = jax.shard_map(
+        partial(
+            _pipeline_local,
+            axis_name=axis,
+            layer_fn=layer_fn,
+            num_microbatches=num_microbatches,
+        ),
+        mesh=mesh,
+        in_specs=(_stage_specs(stacked_params, axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out_mb = fn(stacked_params, x_mb)
+    return out_mb.reshape((b,) + out_mb.shape[2:])

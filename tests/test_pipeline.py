@@ -1,0 +1,126 @@
+"""GPipe-style pipeline parallelism vs the serial layer scan.
+
+The reference never exercises pipeline parallelism (config pass-through
+only, SURVEY.md §2.5); these tests pin our stage-sharded microbatch
+schedule to exact serial-scan numerics, forward and backward, on the
+virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distllm_tpu.parallel.pipeline import (
+    make_pipeline_mesh,
+    pipeline_apply,
+)
+
+
+def _layer_fn(lp, x):
+    # simple residual MLP layer: x + tanh(x @ w + b)
+    return x + jnp.tanh(x @ lp['w'] + lp['b'])
+
+
+def _stack(rng, n_layers, width):
+    return {
+        'w': jnp.asarray(
+            rng.standard_normal((n_layers, width, width)) * 0.3, jnp.float32
+        ),
+        'b': jnp.asarray(rng.standard_normal((n_layers, width)) * 0.1, jnp.float32),
+    }
+
+
+def _serial(params, x):
+    def body(x, lp):
+        return _layer_fn(lp, x), None
+
+    out, _ = jax.lax.scan(body, x, params)
+    return out
+
+
+@pytest.fixture(scope='module')
+def pipe_mesh():
+    return make_pipeline_mesh(4)
+
+
+class TestPipeline:
+    def test_matches_serial_scan(self, rng, pipe_mesh):
+        params = _stack(rng, 8, 16)  # 2 layers per stage
+        x = jnp.asarray(rng.standard_normal((12, 16)), jnp.float32)
+        out = pipeline_apply(
+            params, x, _layer_fn, pipe_mesh, num_microbatches=4
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_serial(params, x)), atol=1e-5
+        )
+
+    def test_microbatch_count_one(self, rng, pipe_mesh):
+        params = _stack(rng, 4, 8)
+        x = jnp.asarray(rng.standard_normal((6, 8)), jnp.float32)
+        out = pipeline_apply(
+            params, x, _layer_fn, pipe_mesh, num_microbatches=1
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_serial(params, x)), atol=1e-5
+        )
+
+    def test_gradients_match_serial(self, rng, pipe_mesh):
+        params = _stack(rng, 4, 8)
+        x = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+
+        def loss_pipe(p):
+            return jnp.sum(
+                pipeline_apply(p, x, _layer_fn, pipe_mesh, num_microbatches=2)
+                ** 2
+            )
+
+        def loss_serial(p):
+            return jnp.sum(_serial(p, x) ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(params)
+        g_serial = jax.grad(loss_serial)(params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_pipe),
+            jax.tree_util.tree_leaves(g_serial),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+            )
+
+    def test_jit_compatible(self, rng, pipe_mesh):
+        params = _stack(rng, 4, 8)
+        x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+        fn = jax.jit(
+            lambda p, x: pipeline_apply(
+                p, x, _layer_fn, pipe_mesh, num_microbatches=2
+            )
+        )
+        np.testing.assert_allclose(
+            np.asarray(fn(params, x)),
+            np.asarray(_serial(params, x)),
+            atol=1e-5,
+        )
+
+    def test_layer_divisibility_guard(self, rng, pipe_mesh):
+        params = _stack(rng, 6, 8)  # 6 layers, 4 stages
+        x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+        with pytest.raises(ValueError, match='not divisible'):
+            pipeline_apply(params, x, _layer_fn, pipe_mesh)
+
+    def test_batch_divisibility_guard(self, rng, pipe_mesh):
+        params = _stack(rng, 4, 8)
+        x = jnp.asarray(rng.standard_normal((5, 8)), jnp.float32)
+        with pytest.raises(ValueError, match='microbatches'):
+            pipeline_apply(params, x, _layer_fn, pipe_mesh, num_microbatches=4)
+
+    def test_eight_stage_mesh(self, rng):
+        mesh = make_pipeline_mesh(8)
+        params = _stack(rng, 8, 8)  # 1 layer per stage
+        x = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+        out = pipeline_apply(params, x, _layer_fn, mesh, num_microbatches=4)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_serial(params, x)), atol=1e-5
+        )
