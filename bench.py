@@ -1,44 +1,49 @@
-"""Headline benchmark: embeddings/sec/chip on a PubMedBERT-class encoder.
+"""Headline benchmarks: embeddings/sec/chip + generation tokens/sec/chip.
 
-Runs the embed pipeline hot loop (bucketed tokenize → jitted bf16 BERT
-forward → mean pool → host copy) on whatever single chip jax provides, and
-prints ONE JSON line::
+Prints ONE JSON line of the driver-contract shape::
 
     {"metric": "embeddings/sec/chip", "value": N, "unit": "emb/s",
-     "vs_baseline": R}
+     "vs_baseline": R, ...extra fields...}
 
-The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is reported
-against an analytic A100 estimate for the same model/batch derived from the
-reference's production config (PubMedBERT batch 512, ``README.md:65``):
-A100 bf16 peak 312 TFLOP/s at 50% MFU on ~2*P*T FLOPs/token. This keeps the
-ratio honest and reproducible rather than inherited from nowhere.
+Extra fields carry the second BASELINE.md metric (generation tokens/sec/chip
+on a Mistral-7B-dims decoder through the continuous-batching engine), MFU
+telemetry for both stages, and an ``error`` field per stage when a stage
+fails — the driver always gets a parseable line, never a bare traceback.
 
-Zero egress: weights are random-init at exact PubMedBERT dims (numerics are
-irrelevant to throughput) and the tokenizer is the deterministic hash-vocab
-one at BERT vocab size.
+Structure: ``python bench.py`` is an orchestrator. It first probes the TPU
+backend in a short-lived subprocess (retrying — round 1 died on a stale
+"backend UNAVAILABLE" state), then runs each stage in its own subprocess
+(``--stage embed`` / ``--stage gen``) so an OOM or backend wedge in one
+stage cannot take down the other, and composes the single output line.
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline`` ratios are
+against analytic A100 estimates derived from the reference's production
+configs, stated inline where computed. Zero egress: weights are random-init
+at exact model dims (numerics are irrelevant to throughput) and the
+tokenizer is the deterministic hash-vocab one.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import numpy as np
+# ----------------------------------------------------------------- stages
 
 
-def _synthetic_corpus(n_docs: int, rng: np.random.Generator) -> list[str]:
-    """Chunk-sized texts (~150-250 'words') like jsonl_chunk buffers."""
-    vocab = [f'tok{i}' for i in range(5000)]
-    texts = []
-    for _ in range(n_docs):
-        n = int(rng.integers(120, 260))
-        texts.append(' '.join(rng.choice(vocab, size=n)))
-    return texts
+def _stage_embed() -> dict:
+    """Embed pipeline hot loop: bucketed tokenize -> jitted bf16 BERT
+    forward -> mean pool -> host copy. PubMedBERT dims
+    (microsoft/S-PubMedBert-MS-MARCO = BERT-base), reference production
+    batch 512 (ref README.md:65)."""
+    import jax
+    import numpy as np
 
-
-def main() -> None:
-    from distllm_tpu.embed import get_encoder, get_pooler
+    from distllm_tpu.embed import get_pooler
     from distllm_tpu.embed.embedders.full_sequence import compute_embeddings
     from distllm_tpu.embed.encoders.base import JaxEncoder
     from distllm_tpu.models import bert
@@ -46,7 +51,6 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
 
-    # PubMedBERT dims (microsoft/S-PubMedBert-MS-MARCO): BERT-base.
     cfg = bert.BertConfig(
         vocab_size=30522,
         hidden_size=768,
@@ -68,36 +72,267 @@ def main() -> None:
     )
     pooler = get_pooler({'name': 'mean'})
 
-    # Reference production config uses batch 512 for PubMedBERT (README.md:65);
-    # it is also the measured sweet spot on v5e (B=128: 1.1k, B=512: 1.6k emb/s).
     batch_size = 512
-    texts = _synthetic_corpus(2048, rng)
+    # Chunk-sized texts (~150-250 'words') like jsonl_chunk buffers.
+    vocab = [f'tok{i}' for i in range(5000)]
+    texts = []
+    for _ in range(2048):
+        n = int(rng.integers(120, 260))
+        texts.append(' '.join(rng.choice(vocab, size=n)))
 
-    # Warmup: one full untimed pass compiles every bucket shape the sorted
-    # batches touch, so the timed pass measures steady state only.
+    # Warmup compiles every bucket shape the sorted batches touch.
     compute_embeddings(texts, encoder, pooler, batch_size)
     jax.block_until_ready(encoder.params)
     start = time.perf_counter()
     out = compute_embeddings(texts, encoder, pooler, batch_size)
     elapsed = time.perf_counter() - start
+    assert out.shape == (len(texts), cfg.hidden_size)
     throughput = len(texts) / elapsed
 
-    # Analytic A100 estimate for the same workload (see module docstring):
-    # ~2 * 110e6 params * 256 tokens/seq FLOPs, 312 TF/s * 50% MFU.
-    flops_per_seq = 2 * 110e6 * 256
+    # Analytic A100 estimate: ~2 * 110e6 params * 256 tokens/seq FLOPs,
+    # 312 TF/s bf16 peak * 50% MFU.
+    tokens_per_seq = 256
+    flops_per_seq = 2 * 110e6 * tokens_per_seq
     a100_estimate = (312e12 * 0.50) / flops_per_seq
 
-    print(
-        json.dumps(
-            {
-                'metric': 'embeddings/sec/chip',
-                'value': round(throughput, 2),
-                'unit': 'emb/s',
-                'vs_baseline': round(throughput / a100_estimate, 3),
-            }
-        )
+    peak = _chip_peak_flops(jax.devices()[0])
+    mfu = throughput * flops_per_seq / peak if peak else None
+    return {
+        'metric': 'embeddings/sec/chip',
+        'value': round(throughput, 2),
+        'unit': 'emb/s',
+        'vs_baseline': round(throughput / a100_estimate, 3),
+        'mfu': round(mfu, 3) if mfu is not None else None,
+        'device': str(jax.devices()[0].device_kind),
+    }
+
+
+def _stage_gen() -> dict:
+    """Generation through the continuous-batching engine at Mistral-7B dims
+    (random bf16 weights on device; numerics irrelevant to throughput).
+
+    Workload shape follows the reference's production serving pattern
+    (mixed prompt lengths, max_num_seqs >= 32 — ref
+    examples/miscellaneous/multi_gpu_batch_config.yaml: max_num_seqs 128,
+    client batch 16; sampling defaults ref vllm_backend.py:19-27)."""
+    import jax
+    import numpy as np
+
+    from distllm_tpu.generate.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
     )
-    assert out.shape == (len(texts), cfg.hidden_size)
+    from distllm_tpu.models import mistral
+
+    if os.environ.get('DISTLLM_BENCH_SMALL'):
+        # Smoke-test dims for CPU CI; real runs use the 7B defaults.
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+        )
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+    params = mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
+    jax.block_until_ready(params)
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+
+    class _Tok:
+        eos_id = None
+
+    # Capacity sized so 7B bf16 weights (13.5 GiB) + paged KV fit one v5e
+    # chip (16 GiB): 480 blocks x 16 tok x 32 L x 8 kv x 128 hd x 2 x bf16
+    # = 0.94 GiB. 24 concurrent seqs at <= 320 tokens never exhaust the
+    # pool, so steady state has no preemption churn.
+    engine_cfg = EngineConfig(
+        block_size=16,
+        # Worst case 24 seqs x blocks_needed(320)=20 = 480, plus the
+        # reserved trash block 0 and a small margin.
+        num_blocks=488,
+        max_num_seqs=24,
+        max_model_len=512,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        list(rng.integers(1, model_cfg.vocab_size, size=int(n)))
+        for n in rng.integers(32, 192, size=64)
+    ]
+    gen_tokens = 128
+    sampling = SamplingParams(
+        temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=gen_tokens
+    )
+    # One warmup prompt per prefill-bucket rung <= max_model_len, so every
+    # prefill shape the timed pass (or a preemption re-prefill) can touch is
+    # compiled outside the timed region; a few decode steps compile the
+    # decode graph.
+    warmup = [
+        list(rng.integers(1, model_cfg.vocab_size, size=n - 1))
+        for n in (16, 32, 64, 128, 256, 512)
+        if n <= engine_cfg.max_model_len
+    ]
+    warmup_sampling = SamplingParams(
+        temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=4
+    )
+
+    # jax.jit is lazy: an unavailable Pallas lowering only surfaces at the
+    # first traced decode, so probe via the warmup and fall back to XLA.
+    backends = ['xla'] if jax.default_backend() == 'cpu' else ['pallas', 'xla']
+    engine = None
+    for backend in backends:
+        engine_cfg.attn_backend = backend
+        candidate = LLMEngine(model_cfg, params, _Tok(), engine_cfg)
+        try:
+            candidate.generate_ids(warmup, warmup_sampling)
+            engine = candidate
+            break
+        except Exception:
+            # Free the failed engine's KV cache before building the
+            # fallback: two live caches beside 7B weights would OOM HBM.
+            candidate.shutdown()
+            if backend == backends[-1]:
+                raise
+    assert engine is not None
+
+    start = time.perf_counter()
+    outs = engine.generate_ids(prompts, sampling)
+    elapsed = time.perf_counter() - start
+    n_tokens = sum(len(o) for o in outs)
+    throughput = n_tokens / elapsed
+
+    # Analytic A100 estimate for decode of this model: the roofline is
+    # min(compute, HBM bandwidth). At batch ~24-32, decode is
+    # weight-bandwidth bound: tokens/s ~= batch * BW_eff / model_bytes with
+    # A100-80GB 2.0e12 B/s at 60% efficiency and bf16 weights. (Per-chip,
+    # an A100 has 2.4x the HBM bandwidth and 1.6x the bf16 FLOPs of a v5e,
+    # so ratios here compare silicon, not software.)
+    flops_per_token = 2 * n_params
+    model_bytes = 2 * n_params
+    a100_bw_bound = engine_cfg.max_num_seqs * (2.0e12 * 0.60) / model_bytes
+    a100_compute_bound = (312e12 * 0.50) / flops_per_token
+    a100_estimate = min(a100_bw_bound, a100_compute_bound)
+
+    peak = _chip_peak_flops(jax.devices()[0])
+    mfu = throughput * flops_per_token / peak if peak else None
+    return {
+        'gen_metric': 'gen tokens/sec/chip',
+        'gen_value': round(throughput, 2),
+        'gen_unit': 'tok/s',
+        'gen_vs_baseline': round(throughput / a100_estimate, 3),
+        'gen_mfu': round(mfu, 4) if mfu is not None else None,
+        'gen_n_tokens': n_tokens,
+        'gen_attn_backend': engine.config.attn_backend,
+    }
+
+
+def _chip_peak_flops(device) -> float | None:
+    """Best-effort bf16 peak FLOP/s for MFU telemetry."""
+    kind = getattr(device, 'device_kind', '') or ''
+    table = {
+        'TPU v4': 275e12,
+        'TPU v5 lite': 197e12,
+        'TPU v5e': 197e12,
+        'TPU v5': 459e12,
+        'TPU v5p': 459e12,
+        'TPU v6 lite': 918e12,
+        'TPU v6e': 918e12,
+    }
+    for name, peak in table.items():
+        if kind.lower().startswith(name.lower()):
+            return peak
+    return None
+
+
+# ------------------------------------------------------------ orchestrator
+
+
+def _probe_backend(attempts: int = 3, timeout: int = 150) -> str | None:
+    """Confirm the TPU backend initializes, in a killable subprocess.
+
+    Round 1's bench died with 'backend UNAVAILABLE' after a wedged earlier
+    process; a hung init here is killed by the timeout and retried rather
+    than hanging the bench itself. Returns None on success, else the error.
+    """
+    err = 'unknown'
+    # Mirror the stage subprocesses: re-apply JAX_PLATFORMS through the
+    # config API so a CPU smoke run probes CPU, not the pinned TPU.
+    probe_src = (
+        'import os, jax\n'
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "jax.config.update('jax_platforms', p) if p else None\n"
+        'print(jax.devices()[0].platform)\n'
+    )
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, '-c', probe_src],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            if proc.returncode == 0:
+                return None
+            err = (proc.stderr or '').strip()[-500:]
+        except subprocess.TimeoutExpired:
+            err = f'backend init timed out after {timeout}s'
+        if attempt < attempts - 1:
+            time.sleep(5 * (attempt + 1))
+    return err
+
+
+def _run_stage(stage: str, timeout: int) -> dict:
+    """Run one stage in a subprocess; parse its single JSON stdout line."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), '--stage', stage],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {f'{stage}_error': f'stage timed out after {timeout}s'}
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or '').strip()[-800:]
+        return {f'{stage}_error': tail}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {f'{stage}_error': f'no JSON in stage output: {proc.stdout[-300:]}'}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--stage', choices=['embed', 'gen'])
+    args = parser.parse_args()
+
+    # The environment's sitecustomize pins jax_platforms='axon,cpu' at
+    # interpreter start, which overrides the JAX_PLATFORMS env var; re-apply
+    # the env var through the config API so `JAX_PLATFORMS=cpu python
+    # bench.py --stage gen` really runs on CPU (smoke tests).
+    if args.stage and os.environ.get('JAX_PLATFORMS'):
+        import jax
+
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+    if args.stage == 'embed':
+        print(json.dumps(_stage_embed()))
+        return
+    if args.stage == 'gen':
+        print(json.dumps(_stage_gen()))
+        return
+
+    result: dict = {
+        'metric': 'embeddings/sec/chip',
+        'value': 0.0,
+        'unit': 'emb/s',
+        'vs_baseline': 0.0,
+    }
+    probe_err = _probe_backend()
+    if probe_err is not None:
+        result['error'] = f'TPU backend unavailable: {probe_err}'
+        print(json.dumps(result))
+        return
+
+    result.update(_run_stage('embed', timeout=1200))
+    result.update(_run_stage('gen', timeout=2400))
+    print(json.dumps(result))
 
 
 if __name__ == '__main__':

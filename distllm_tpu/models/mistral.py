@@ -102,6 +102,56 @@ def init(rng: jax.Array, cfg: MistralConfig) -> dict:
     return params
 
 
+def init_on_device(rng: jax.Array, cfg: MistralConfig) -> dict:
+    """Random params generated directly on device in ``cfg.dtype``.
+
+    ``init`` materialises fp32 numpy on host (fine for test-sized models,
+    and the fp32 master copy is what ``params_from_hf`` produces too); at
+    7B dims that is 29 GB and cannot live in a 16 GB chip's HBM.  Serving
+    only ever reads the weights in ``cfg.dtype``, so for benchmarks we
+    generate the stacked layer tree straight on device in that dtype —
+    one RNG call per parameter *kind* (leading L axis), never per layer.
+    """
+    h = cfg.hidden_size
+    hd = cfg.head_size
+    q_out = cfg.num_heads * hd
+    kv_out = cfg.num_kv_heads * hd
+    i = cfg.intermediate_size
+    L = cfg.num_layers
+    dtype = jnp.dtype(cfg.dtype)
+    scale = 0.02
+
+    keys = jax.random.split(rng, 9)
+
+    @jax.jit
+    def build():
+        def normal(key, shape):
+            return jax.random.normal(key, shape, dtype=jnp.float32).astype(
+                dtype
+            ) * scale
+
+        params = {
+            'embed': normal(keys[0], (cfg.vocab_size, h)),
+            'layers': {
+                'q': {'kernel': normal(keys[1], (L, h, q_out))},
+                'k': {'kernel': normal(keys[2], (L, h, kv_out))},
+                'v': {'kernel': normal(keys[3], (L, h, kv_out))},
+                'o': {'kernel': normal(keys[4], (L, q_out, h))},
+                'attn_ln': {'scale': jnp.ones((L, h), dtype)},
+                'gate': {'kernel': normal(keys[5], (L, h, i))},
+                'up': {'kernel': normal(keys[6], (L, h, i))},
+                'down': {'kernel': normal(keys[7], (L, i, h))},
+                'mlp_ln': {'scale': jnp.ones((L, h), dtype)},
+            },
+            'final_ln': {'scale': jnp.ones((h,), dtype)},
+        }
+        if not cfg.tie_word_embeddings:
+            params['lm_head'] = normal(keys[8], (h, cfg.vocab_size))
+        return params
+
+    return build()
+
+
 def _rope_tables(cfg: MistralConfig, max_len: int):
     cos, sin = common.rope_frequencies(cfg.head_size, max_len, cfg.rope_theta)
     return jnp.asarray(cos), jnp.asarray(sin)
