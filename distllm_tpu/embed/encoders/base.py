@@ -90,10 +90,19 @@ class JaxEncoder:
         loop off the dispatch-latency floor (dominant when the chip sits
         behind a remote tunnel); XLA also fuses the pooling reduction into
         the final layer's epilogue instead of re-reading ``[B, S, H]``.
-        Cached per (pooler, normalize) so bucketed shapes re-specialize the
-        same traced function.
+        Cached per (pooler type, pooler config, normalize): the closure
+        captures the pooler instance, so a same-class pooler with different
+        config must not reuse another instance's trace — but fresh
+        same-config instances (one per work item in the embedding driver)
+        MUST share it, or every file recompiles the fused graph.
         """
-        key = (type(pooler).__name__, normalize)
+        pooler_cfg = getattr(pooler, 'config', None)
+        cfg_key = (
+            pooler_cfg.model_dump_json()
+            if hasattr(pooler_cfg, 'model_dump_json')
+            else repr(pooler_cfg)
+        )
+        key = (type(pooler).__qualname__, cfg_key, normalize)
         fused = self._pooled_cache.get(key)
         if fused is None:
             apply = self._apply

@@ -29,7 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from distllm_tpu.generate.engine.kv_cache import PagedKVCache
-from distllm_tpu.generate.engine.scheduler import make_scheduler
+from distllm_tpu.generate.engine.scheduler import (
+    SchedulerExhausted,
+    make_scheduler,
+)
 from distllm_tpu.models import mistral
 from distllm_tpu.models.tokenizer import bucket_ladder, pick_bucket
 from distllm_tpu.ops.paged_attention import write_prefill_kv
@@ -249,7 +252,16 @@ class LLMEngine:
         # next token, preempting the youngest on OOM (recompute preemption:
         # output_ids stay intact, so results and token budgets are
         # unaffected; the request re-prefills on re-admission).
-        for rid in self.sched.prepare_decode():
+        try:
+            preempted = self.sched.prepare_decode()
+        except SchedulerExhausted as exc:
+            # Preemptions performed before the fatal exhaustion are not
+            # rolled back; sync their states so a caller that catches and
+            # continues sees engine state consistent with the scheduler.
+            for rid in exc.preempted:
+                self._requests[rid].state = RequestState.WAITING
+            raise
+        for rid in preempted:
             self._requests[rid].state = RequestState.WAITING
         # O(max_num_seqs) slot-table read, not a scan of every queued request.
         running = [

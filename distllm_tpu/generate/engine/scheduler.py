@@ -29,7 +29,17 @@ from typing import Protocol
 
 
 class SchedulerExhausted(RuntimeError):
-    """The block pool cannot serve even a lone request; raise to the caller."""
+    """The block pool cannot serve even a lone request; raise to the caller.
+
+    ``preempted`` carries rids already moved to the waiting queue by the
+    same (failed) ``prepare_decode`` call — the engine must mark those
+    requests WAITING before propagating, or its state diverges from the
+    scheduler's.
+    """
+
+    def __init__(self, message: str, preempted: list[int] | None = None):
+        super().__init__(message)
+        self.preempted = list(preempted or [])
 
 
 class Scheduler(Protocol):
@@ -147,7 +157,8 @@ class PyScheduler:
                 if victim is None:
                     raise SchedulerExhausted(
                         'KV cache exhausted with a single running sequence; '
-                        'increase num_blocks or reduce max_model_len'
+                        'increase num_blocks or reduce max_model_len',
+                        preempted=preempted,
                     )
                 preempted.append(victim)
                 if victim == rid:
@@ -275,9 +286,12 @@ class NativeScheduler:
         out = (ctypes.c_int64 * self._max_num_seqs)()
         n = int(self._lib.sched_prepare_decode(self._handle, out))
         if n < 0:
+            # Fatal encoding is -(1 + n_preempted): preemptions already
+            # performed are not rolled back and must reach the engine.
             raise SchedulerExhausted(
                 'KV cache exhausted with a single running sequence; '
-                'increase num_blocks or reduce max_model_len'
+                'increase num_blocks or reduce max_model_len',
+                preempted=[int(out[i]) for i in range(-n - 1)],
             )
         return [int(out[i]) for i in range(n)]
 

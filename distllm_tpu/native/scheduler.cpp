@@ -162,7 +162,11 @@ int64_t sched_admit_next(void* h) {
 // Ensure every running sequence has block capacity for one more token,
 // preempting the youngest on OOM. Preempted rids are written to
 // out_preempted (capacity = max_num_seqs). Returns the preempted count, or
-// -1 when the pool is exhausted with a single running sequence (fatal).
+// -(1 + n_preempted) when the pool is exhausted with a single running
+// sequence (fatal) — preemptions already performed in this call are NOT
+// rolled back (their requests sit in the waiting queue), so the caller must
+// read out_preempted[0..n_preempted) and sync its request states before
+// raising.
 int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {
     auto* s = static_cast<Scheduler*>(h);
     int32_t n_preempted = 0;
@@ -174,7 +178,7 @@ int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {
         bool preempted_self = false;
         while (!s->extend(req, req.num_tokens + 1)) {
             int64_t victim = s->preempt_youngest();
-            if (victim < 0) return -1;
+            if (victim < 0) return -(1 + n_preempted);
             out_preempted[n_preempted++] = victim;
             if (victim == rid) {
                 preempted_self = true;

@@ -72,15 +72,29 @@ class BaseConfig(BaseModel):
             fh.write(self.model_dump_json(indent=2))
 
 
-def instantiate(config: Any, **overrides: Any) -> Any:
+#: Import prefixes ``instantiate`` accepts by default. The reference
+#: dispatches ``_target_`` through an explicit class allowlist
+#: (``chat_argoproxy.py:511-549``); an unrestricted import+call would let
+#: any loaded YAML execute arbitrary code. Extend via the ``allow``
+#: argument for operator-trusted configs.
+INSTANTIATE_ALLOWED_PREFIXES: tuple[str, ...] = ('distllm_tpu.',)
+
+
+def instantiate(
+    config: Any, _allow_: tuple[str, ...] | None = None, **overrides: Any
+) -> Any:
     """``_target_``-field class dispatch (reference ``chat_argoproxy.py:511-549``).
 
     A dict carrying ``_target_: 'pkg.module.ClassName'`` is resolved by
     import and constructed from the remaining keys; nested dicts instantiate
     recursively (depth-first), and ``${env:VAR}`` markers substitute first.
-    Non-``_target_`` values pass through unchanged.
+    Non-``_target_`` values pass through unchanged. Targets must fall under
+    ``INSTANTIATE_ALLOWED_PREFIXES`` (or the explicit ``_allow_`` prefixes —
+    underscored like ``_target_`` so it can never collide with a
+    constructor override name).
     """
     config = _substitute_env(config)
+    allowed = INSTANTIATE_ALLOWED_PREFIXES + tuple(_allow_ or ())
 
     def build(obj: Any) -> Any:
         if isinstance(obj, dict):
@@ -94,6 +108,12 @@ def instantiate(config: Any, **overrides: Any) -> Any:
             if not module_name:
                 raise ValueError(
                     f"_target_ must be a dotted path, got {target!r}"
+                )
+            if not any(str(target).startswith(p) for p in allowed):
+                raise ValueError(
+                    f"_target_ {target!r} is outside the allowed prefixes "
+                    f'{allowed}; pass allow=("your.pkg.",) for '
+                    'operator-trusted configs'
                 )
             cls = getattr(importlib.import_module(module_name), attr)
             return cls(**built)

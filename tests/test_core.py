@@ -159,11 +159,28 @@ class TestInstantiate:
 
         obj = instantiate(
             {'_target_': 'pathlib.PurePosixPath', 'args': None}
-            | {'_target_': 'collections.Counter'}
+            | {'_target_': 'collections.Counter'},
+            _allow_=('collections.',),
         )
         import collections
 
         assert isinstance(obj, collections.Counter)
+
+    def test_target_outside_allowlist_rejected(self):
+        # Unrestricted import+call would let any loaded YAML execute
+        # arbitrary code; default allowlist is distllm_tpu.* only.
+        from distllm_tpu.utils import instantiate
+
+        with pytest.raises(ValueError, match='allowed prefixes'):
+            instantiate({'_target_': 'os.system', 'command': 'true'})
+
+    def test_target_within_package_allowed_by_default(self):
+        from distllm_tpu.utils import instantiate
+
+        timer = instantiate({'_target_': 'distllm_tpu.timer.Timer'})
+        from distllm_tpu.timer import Timer
+
+        assert isinstance(timer, Timer)
 
     def test_nested_and_env(self, monkeypatch):
         from distllm_tpu.utils import instantiate
@@ -173,7 +190,8 @@ class TestInstantiate:
             {
                 'inner': {'_target_': 'fractions.Fraction', 'numerator': 3},
                 'plain': '${env:VFY_NAME}',
-            }
+            },
+            _allow_=('fractions.',),
         )
         import fractions
 

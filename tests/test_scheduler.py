@@ -59,8 +59,10 @@ def drive(sched, seed: int, steps: int = 200):
             if sched.num_running:
                 try:
                     preempted = sched.prepare_decode()
-                except SchedulerExhausted:
-                    preempted = ['EXHAUSTED']
+                except SchedulerExhausted as exc:
+                    # Fatal path reports prior same-call preemptions too;
+                    # both implementations must agree on them.
+                    preempted = ['EXHAUSTED', tuple(exc.preempted)]
                 trace.append(('prepare', tuple(preempted)))
                 for rid in list(live):
                     if sched.slot(rid) >= 0:
@@ -168,6 +170,23 @@ class TestPolicy:
         s.append_token(0)
         with pytest.raises(SchedulerExhausted):
             s.prepare_decode()  # needs a 4th block, pool has 3 usable
+
+    def test_exhausted_reports_prior_preemptions(self, sched_factory):
+        # rid 0 grows so much in one prepare_decode that preempting BOTH
+        # younger sequences still cannot satisfy it: the fatal error must
+        # carry the preemptions already performed (they are not rolled
+        # back — their requests sit in the waiting queue).
+        s = sched_factory(num_blocks=10, block_size=1, max_num_seqs=3)
+        for rid in (0, 1, 2):
+            s.add(rid, 2)
+            assert s.admit_next() == rid  # 3 blocks each: pool now empty
+        for _ in range(7):
+            s.append_token(0)  # rid 0 now needs blocks for 10 tokens
+        with pytest.raises(SchedulerExhausted) as excinfo:
+            s.prepare_decode()
+        assert excinfo.value.preempted == [2, 1]
+        assert s.slot(1) == -1 and s.slot(2) == -1
+        assert s.num_waiting == 2
 
     def test_admit_impossible_request_raises(self, sched_factory):
         s = sched_factory(num_blocks=4, block_size=1, max_num_seqs=2)
