@@ -95,12 +95,32 @@ class LLMEngine:
         params: dict,
         tokenizer,
         config: EngineConfig | None = None,
+        mesh=None,
     ) -> None:
         self.model_cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
         self.config = config or EngineConfig()
         cfg = self.config
+
+        # Tensor parallelism: K/V pages shard over the kv-head dim on the
+        # mesh's model axis (same split as the attention heads in
+        # param_specs), so paged gather/scatter stays local per shard;
+        # host-built step inputs (ids / positions / block tables) are
+        # replicated explicitly — committed single-device arrays would
+        # conflict with mesh-sharded params inside the jitted step.
+        kv_sharding = None
+        self._replicated = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if model_cfg.num_kv_heads % mesh.shape.get('model', 1):
+                raise ValueError(
+                    f'num_kv_heads={model_cfg.num_kv_heads} not divisible '
+                    f"by tensor parallel degree {mesh.shape.get('model', 1)}"
+                )
+            kv_sharding = NamedSharding(mesh, P(None, None, None, 'model'))
+            self._replicated = NamedSharding(mesh, P())
 
         self.kv = PagedKVCache(
             num_layers=model_cfg.num_layers,
@@ -109,6 +129,7 @@ class LLMEngine:
             num_kv_heads=model_cfg.num_kv_heads,
             head_dim=model_cfg.head_size,
             dtype=model_cfg.dtype,
+            sharding=kv_sharding,
         )
         self.max_blocks_per_seq = self.kv.blocks_needed(cfg.max_model_len)
         self.prefill_buckets = bucket_ladder(
@@ -166,6 +187,12 @@ class LLMEngine:
         )
         self._sample = jax.jit(sample_tokens)
 
+    def _put(self, x):
+        """Host value → device array, replicated over the mesh under TP."""
+        if self._replicated is not None:
+            return jax.device_put(x, self._replicated)
+        return jnp.asarray(x)
+
     # ------------------------------------------------------------- requests
     def add_request(
         self, prompt_ids: list[int], params: SamplingParams | None = None
@@ -219,14 +246,16 @@ class LLMEngine:
         ids[0, : len(prompt)] = prompt
         mask[0, : len(prompt)] = 1
 
-        logits_all, k_all, v_all = self._prefill(self.params, ids, mask)
+        logits_all, k_all, v_all = self._prefill(
+            self.params, self._put(ids), self._put(mask)
+        )
         block_row = self._block_row(request.request_id)
         self.kv.k, self.kv.v = self._write_prefill(
             self.kv.k,
             self.kv.v,
             k_all[:, 0],
             v_all[:, 0],
-            jnp.asarray(block_row),
+            self._put(block_row),
             jnp.int32(len(prompt)),
         )
         # First token sampled from the last valid prompt position.
@@ -290,12 +319,12 @@ class LLMEngine:
 
         logits, self.kv.k, self.kv.v = self._decode(
             self.params,
-            jnp.asarray(ids),
-            jnp.asarray(positions),
+            self._put(ids),
+            self._put(positions),
             self.kv.k,
             self.kv.v,
-            jnp.asarray(block_tables),
-            jnp.asarray(context_lens),
+            self._put(block_tables),
+            self._put(context_lens),
         )
         tokens = self._sample_batch(logits, slot_requests)
         for slot, request in running:
@@ -320,9 +349,9 @@ class LLMEngine:
             self._sample(
                 logits,
                 key,
-                jnp.asarray(temperature),
-                jnp.asarray(top_p),
-                jnp.asarray(min_p),
+                self._put(temperature),
+                self._put(top_p),
+                self._put(min_p),
             )
         )
 

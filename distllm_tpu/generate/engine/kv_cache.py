@@ -136,10 +136,22 @@ class PagedKVCache:
         num_kv_heads: int,
         head_dim: int,
         dtype: str = 'bfloat16',
+        sharding=None,
     ) -> None:
         shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype=jnp.dtype(dtype))
-        self.v = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+        if sharding is None:
+            self.k = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+            self.v = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+        else:
+            # Allocate directly into the sharded layout: under tensor
+            # parallelism num_blocks is sized against AGGREGATE HBM, so a
+            # transient full-size allocation on one device would OOM.
+            zeros = jax.jit(
+                lambda: jnp.zeros(shape, dtype=jnp.dtype(dtype)),
+                out_shardings=sharding,
+            )
+            self.k = zeros()
+            self.v = zeros()
         self.block_size = block_size
         self.num_blocks = num_blocks
 

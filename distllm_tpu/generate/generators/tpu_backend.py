@@ -75,6 +75,7 @@ class TpuGenerator:
             params = quantize_pytree(
                 params, mode=quant_mode, out_dtype=model_cfg.dtype
             )
+        mesh = None
         if config.tensor_parallel_size > 1:
             mesh = make_mesh(
                 MeshSpec(data=1, model=config.tensor_parallel_size),
@@ -100,6 +101,7 @@ class TpuGenerator:
                 max_model_len=config.max_model_len,
                 quantization=quant_mode,
             ),
+            mesh=mesh,
         )
 
     def _sampling_params(self) -> SamplingParams:
