@@ -161,28 +161,25 @@ def _stage_gen() -> dict:
     sampling = SamplingParams(
         temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=gen_tokens
     )
-    # One warmup prompt per prefill-bucket rung <= max_model_len, so every
-    # prefill shape the timed pass (or a preemption re-prefill) can touch is
-    # compiled outside the timed region; a few decode steps compile the
-    # decode graph.
-    warmup = [
-        list(rng.integers(1, model_cfg.vocab_size, size=n - 1))
-        for n in (16, 32, 64, 128, 256, 512)
-        if n <= engine_cfg.max_model_len
-    ]
-    warmup_sampling = SamplingParams(
-        temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=4
-    )
 
-    # jax.jit is lazy: an unavailable Pallas lowering only surfaces at the
-    # first traced decode, so probe via the warmup and fall back to XLA.
+    # engine.warmup() compiles every (batch, bucket) prefill shape, the KV
+    # scatter, the decode step, and the samplers outside the timed region;
+    # the persistent compilation cache (enabled in main) makes repeat runs
+    # start hot. jax.jit is lazy, so an unavailable Pallas lowering only
+    # surfaces here — probe via warmup and fall back to XLA.
     backends = ['xla'] if jax.default_backend() == 'cpu' else ['pallas', 'xla']
     engine = None
     for backend in backends:
         engine_cfg.attn_backend = backend
         candidate = LLMEngine(model_cfg, params, _Tok(), engine_cfg)
         try:
-            candidate.generate_ids(warmup, warmup_sampling)
+            candidate.warmup()
+            candidate.generate_ids(
+                prompts[:2],
+                SamplingParams(
+                    temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=4
+                ),
+            )
             engine = candidate
             break
         except Exception:
@@ -306,10 +303,21 @@ def main() -> None:
     # interpreter start, which overrides the JAX_PLATFORMS env var; re-apply
     # the env var through the config API so `JAX_PLATFORMS=cpu python
     # bench.py --stage gen` really runs on CPU (smoke tests).
-    if args.stage and os.environ.get('JAX_PLATFORMS'):
+    if args.stage:
         import jax
 
-        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+        if os.environ.get('JAX_PLATFORMS'):
+            jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+        # XLA compiles amortize across runs (the 7B engine has ~25 serving
+        # shapes); harmless if the backend doesn't support the cache.
+        try:
+            jax.config.update(
+                'jax_compilation_cache_dir',
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             '.jax_cache'),
+            )
+        except Exception:
+            pass
 
     if args.stage == 'embed':
         print(json.dumps(_stage_embed()))

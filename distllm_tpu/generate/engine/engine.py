@@ -79,6 +79,15 @@ class EngineConfig(BaseConfig):
     max_num_seqs: int = 8
     max_model_len: int = 1024
     prefill_min_bucket: int = 16
+    # Admitted requests with the same length bucket prefill together in one
+    # padded dispatch (vLLM batches prefills via max_num_batched_tokens);
+    # batch dim is bucketed to powers of two up to this cap to bound the
+    # jit cache.
+    max_prefill_batch: int = 8
+    # Upper bound on batch x bucket tokens per prefill dispatch (the vLLM
+    # max_num_batched_tokens analogue); also bounds the number of compiled
+    # prefill shapes per bucket.
+    max_prefill_tokens: int = 2048
     # Governs the scheduler implementation (C++ core vs Python twin).
     prefer_native_allocator: bool = True
     attn_backend: str = 'xla'  # 'xla' | 'pallas' (TPU decode kernel)
@@ -167,10 +176,15 @@ class LLMEngine:
             def _deq(p):
                 return p
 
-        def prefill_fn(params, ids, mask):
+        def prefill_fn(params, ids, mask, last_pos):
             params = _deq(params)
             hidden, k, v = mistral.prefill(params, model, ids, mask)
-            return mistral.logits(params, model, hidden), k, v
+            # Only the last valid position's logits are sampled; computing
+            # the lm_head for [B, S, V] would waste MXU time and HBM.
+            last_hidden = jnp.take_along_axis(
+                hidden, last_pos[:, None, None], axis=1
+            )
+            return mistral.logits(params, model, last_hidden)[:, 0], k, v
 
         self._prefill = jax.jit(prefill_fn)
 
@@ -192,6 +206,58 @@ class LLMEngine:
         if self._replicated is not None:
             return jax.device_put(x, self._replicated)
         return jnp.asarray(x)
+
+    def warmup(self) -> None:
+        """Compile every serving shape outside the request path.
+
+        Runs each (batch, bucket) prefill the admission policy can emit,
+        the KV scatter, the full-batch decode step, and the per-shape
+        samplers on dummy inputs. Block tables are all zero, so every K/V
+        write lands in the reserved trash block — scheduler state and real
+        cache contents are untouched. Combine with jax's persistent
+        compilation cache to make later processes start hot.
+        """
+        saved_key = self._key  # sampling stream must not observe warmup
+        for bucket in self.prefill_buckets:
+            cap = self._prefill_batch_cap(bucket)
+            b = 1
+            while True:
+                ids = np.zeros((b, bucket), np.int32)
+                mask = np.ones((b, bucket), np.int32)
+                last_pos = np.zeros((b,), np.int32)
+                lengths = np.zeros((b,), np.int32)  # all writes -> trash
+                block_rows = np.zeros((b, self.max_blocks_per_seq), np.int32)
+                logits, k_all, v_all = self._prefill(
+                    self.params,
+                    self._put(ids),
+                    self._put(mask),
+                    self._put(last_pos),
+                )
+                self.kv.k, self.kv.v = self._write_prefill(
+                    self.kv.k,
+                    self.kv.v,
+                    k_all,
+                    v_all,
+                    self._put(block_rows),
+                    self._put(lengths),
+                )
+                self._sample_batch(logits, [None] * b)
+                if b >= cap:
+                    break
+                b *= 2
+        bsz = self.config.max_num_seqs
+        logits, self.kv.k, self.kv.v = self._decode(
+            self.params,
+            self._put(np.zeros((bsz,), np.int32)),
+            self._put(np.zeros((bsz,), np.int32)),
+            self.kv.k,
+            self.kv.v,
+            self._put(np.zeros((bsz, self.max_blocks_per_seq), np.int32)),
+            self._put(np.ones((bsz,), np.int32)),
+        )
+        self._sample_batch(logits, [None] * bsz)
+        jax.block_until_ready(self.kv.k)
+        self._key = saved_key
 
     # ------------------------------------------------------------- requests
     def add_request(
@@ -225,44 +291,106 @@ class LLMEngine:
         """Admit waiting requests while the scheduler allows.
 
         Returns the first tokens emitted by prefill as (request_id, token).
-        A prefill may immediately finish its request (stop token /
-        max_tokens=1), freeing the slot for the next admission in the same
-        step — hence admission is incremental, not batch-planned.
+        Admissible requests are batch-planned: grouped by prompt-length
+        bucket and prefilled together in one padded dispatch (under many
+        short requests — the MCQA pattern — per-sequence prefill serializes
+        admission behind dispatch latency). A prefill may immediately
+        finish its request (stop token / max_tokens=1), freeing slots, so
+        the admit→prefill cycle repeats until the scheduler yields nothing.
         """
         emitted: list[tuple[int, int]] = []
-        while (rid := self.sched.admit_next()) is not None:
-            request = self._requests[rid]
-            request.state = RequestState.RUNNING
-            emitted.append((rid, self._run_prefill(request)))
-        return emitted
+        while True:
+            admitted: list[Request] = []
+            while (rid := self.sched.admit_next()) is not None:
+                request = self._requests[rid]
+                request.state = RequestState.RUNNING
+                admitted.append(request)
+            if not admitted:
+                return emitted
+            groups: dict[int, list[Request]] = {}
+            for request in admitted:
+                # Re-prefill covers generated tokens too (recompute
+                # preemption path).
+                length = request.num_tokens
+                bucket = pick_bucket(length, self.prefill_buckets)
+                groups.setdefault(bucket, []).append(request)
+            for bucket, requests in sorted(groups.items()):
+                cap = self._prefill_batch_cap(bucket)
+                for i in range(0, len(requests), cap):
+                    emitted.extend(
+                        self._run_prefill_batch(requests[i : i + cap], bucket)
+                    )
+
+    def _prefill_batch_cap(self, bucket: int) -> int:
+        """Largest pow2 batch for this bucket under the prefill caps.
+
+        Also bounded by pow2ceil(max_num_seqs): no admission group can
+        exceed the slot count, so larger shapes would be compiled (by
+        ``warmup``) but never dispatched.
+        """
+        cap = min(
+            self.config.max_prefill_batch,
+            max(1, self.config.max_prefill_tokens // bucket),
+        )
+        b = 1
+        while b * 2 <= cap:
+            b *= 2
+        seqs_ceil = 1
+        while seqs_ceil < self.config.max_num_seqs:
+            seqs_ceil *= 2
+        return min(b, seqs_ceil)
 
     # -------------------------------------------------------------- prefill
-    def _run_prefill(self, request: Request) -> int:
-        # Re-prefill covers generated tokens too (recompute preemption path).
-        prompt = request.prompt_ids + request.output_ids
-        bucket = pick_bucket(len(prompt), self.prefill_buckets)
-        ids = np.zeros((1, bucket), np.int32)
-        mask = np.zeros((1, bucket), np.int32)
-        ids[0, : len(prompt)] = prompt
-        mask[0, : len(prompt)] = 1
+    def _run_prefill_batch(
+        self, requests: list[Request], bucket: int
+    ) -> list[tuple[int, int]]:
+        """Prefill same-bucket requests in one padded dispatch.
 
-        logits_all, k_all, v_all = self._prefill(
-            self.params, self._put(ids), self._put(mask)
+        The batch dim pads up the pow2 ladder (capped at
+        ``max_prefill_batch``) so the jit cache holds at most
+        O(log batch x log length) prefill shapes. Padding rows carry
+        length 0: their K/V scatter lands in trash block 0 and their
+        sampled token is discarded.
+        """
+        b = 1
+        while b < len(requests):
+            b *= 2
+        ids = np.zeros((b, bucket), np.int32)
+        mask = np.zeros((b, bucket), np.int32)
+        last_pos = np.zeros((b,), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        block_rows = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        for i, request in enumerate(requests):
+            prompt = request.prompt_ids + request.output_ids
+            ids[i, : len(prompt)] = prompt
+            mask[i, : len(prompt)] = 1
+            last_pos[i] = len(prompt) - 1
+            lengths[i] = len(prompt)
+            block_rows[i] = self._block_row(request.request_id)
+
+        last_logits, k_all, v_all = self._prefill(
+            self.params, self._put(ids), self._put(mask), self._put(last_pos)
         )
-        block_row = self._block_row(request.request_id)
         self.kv.k, self.kv.v = self._write_prefill(
             self.kv.k,
             self.kv.v,
-            k_all[:, 0],
-            v_all[:, 0],
-            self._put(block_row),
-            jnp.int32(len(prompt)),
+            k_all,
+            v_all,
+            self._put(block_rows),
+            self._put(lengths),
         )
-        # First token sampled from the last valid prompt position.
-        last_logits = logits_all[0, len(prompt) - 1][None]
-        token = int(self._sample_batch(last_logits, [request])[0])
-        self._emit_token(request, token)
-        return token
+        # First token of each sequence, sampled from its last prompt
+        # position; padding rows sample too but are dropped here.
+        slots: list[Request | None] = list(requests) + [None] * (
+            b - len(requests)
+        )
+        tokens = self._sample_batch(last_logits, slots)
+        emitted = []
+        for i, request in enumerate(requests):
+            token = int(tokens[i])
+            self._emit_token(request, token)
+            emitted.append((request.request_id, token))
+        return emitted
 
     def _block_row(self, rid: int) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -420,14 +548,33 @@ class LLMEngine:
         self.kv = None
 
 
-def _write_prefill_all_layers(k_cache, v_cache, k_seq, v_seq, block_row, length):
-    """Scatter ``[L, S, N_kv, Hd]`` prefill K/V into the paged cache."""
-    seq_len = k_seq.shape[1]
+def _write_prefill_all_layers(
+    k_cache, v_cache, k_seq, v_seq, block_rows, lengths
+):
+    """Scatter ``[L, B, S, N_kv, Hd]`` prefill K/V into the paged cache.
+
+    ``block_rows`` is ``[B, R]`` and ``lengths`` ``[B]``; positions at or
+    beyond a row's length (padding rows have length 0) write to the
+    reserved trash block 0.
+    """
+    num_layers, batch, seq_len = k_seq.shape[:3]
     block_size = k_cache.shape[2]
-    positions = jnp.arange(seq_len)
-    valid = positions < length
-    block_ids = jnp.where(valid, block_row[positions // block_size], 0)
+    positions = jnp.arange(seq_len)[None, :]  # [1, S]
+    valid = positions < lengths[:, None]  # [B, S]
+    block_ids = jnp.where(
+        valid,
+        jnp.take_along_axis(block_rows, positions // block_size, axis=1),
+        0,
+    )
     offsets = jnp.where(valid, positions % block_size, 0)
-    k_cache = k_cache.at[:, block_ids, offsets].set(k_seq.astype(k_cache.dtype))
-    v_cache = v_cache.at[:, block_ids, offsets].set(v_seq.astype(v_cache.dtype))
+    flat_blocks = block_ids.reshape(-1)
+    flat_offsets = offsets.reshape(-1)
+    k_flat = k_seq.reshape(num_layers, batch * seq_len, *k_seq.shape[3:])
+    v_flat = v_seq.reshape(num_layers, batch * seq_len, *v_seq.shape[3:])
+    k_cache = k_cache.at[:, flat_blocks, flat_offsets].set(
+        k_flat.astype(k_cache.dtype)
+    )
+    v_cache = v_cache.at[:, flat_blocks, flat_offsets].set(
+        v_flat.astype(v_cache.dtype)
+    )
     return k_cache, v_cache

@@ -240,6 +240,61 @@ def test_engine_greedy_matches_dense_forward():
         assert out == ref, f'{out} != {ref}'
 
 
+def test_engine_batched_prefill_matches_dense_forward():
+    """Many same-bucket prompts prefill in one padded dispatch; tokens must
+    match the dense greedy reference exactly (padding rows are discarded,
+    their K/V lands in the trash block)."""
+    cfg, params, engine = _tiny_engine(num_blocks=128, max_num_seqs=8)
+    rng = np.random.default_rng(3)
+    # 6 prompts in the same 8-bucket + 3 in the 16-bucket: exercises a
+    # full-8 pad, a partial pad, and cross-bucket grouping in one _admit.
+    prompts = [list(rng.integers(1, 64, size=6)) for _ in range(6)]
+    prompts += [list(rng.integers(1, 64, size=12)) for _ in range(3)]
+    assert engine._prefill_batch_cap(8) >= 4
+    outs = engine.generate_ids(prompts, SamplingParams(temperature=0.0, max_tokens=5))
+    for prompt, out in zip(prompts, outs):
+        assert out == _dense_greedy_reference(cfg, params, prompt, 5)
+
+
+def test_engine_warmup_compiles_without_state_damage():
+    """warmup() must not disturb scheduler state, the sampling RNG stream,
+    or later generations."""
+    cfg, params, engine = _tiny_engine()
+    key_before = engine._key
+    engine.warmup()
+    assert engine.sched.num_running == 0
+    assert engine.sched.num_free_blocks == 63  # all but trash block 0
+    assert (np.asarray(engine._key) == np.asarray(key_before)).all()
+    prompts = [[5, 9, 12], [7, 3, 22, 31]]
+    outs = engine.generate_ids(prompts, SamplingParams(temperature=0.0, max_tokens=4))
+    for prompt, out in zip(prompts, outs):
+        assert out == _dense_greedy_reference(cfg, params, prompt, 4)
+    # Seeded stochastic sampling reproduces between warmed/unwarmed engines
+    # (both straight out of construction; warmup must not advance the key).
+    _, _, warmed = _tiny_engine()
+    warmed.warmup()
+    _, _, fresh = _tiny_engine()
+    sp = SamplingParams(temperature=0.9, max_tokens=6)
+    assert warmed.generate_ids([[4, 2]], sp) == fresh.generate_ids([[4, 2]], sp)
+
+
+def test_prefill_batch_cap_bounded_by_max_num_seqs():
+    cfg, params, engine = _tiny_engine(max_num_seqs=3)
+    engine.config.max_prefill_batch = 8
+    # groups can never exceed 3 running slots -> pads to at most 4
+    assert engine._prefill_batch_cap(8) == 4
+
+
+def test_prefill_batch_cap_honors_token_budget():
+    cfg, params, engine = _tiny_engine(max_num_seqs=8)
+    engine.config.max_prefill_tokens = 64
+    engine.config.max_prefill_batch = 8
+    assert engine._prefill_batch_cap(8) == 8
+    assert engine._prefill_batch_cap(16) == 4
+    assert engine._prefill_batch_cap(64) == 1
+    assert engine._prefill_batch_cap(128) == 1
+
+
 def test_engine_continuous_batching_join_leave():
     """Requests with different lengths join/leave the batch mid-flight."""
     cfg, params, engine = _tiny_engine(max_num_seqs=2)
