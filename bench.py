@@ -107,14 +107,18 @@ def _stage_embed() -> dict:
     }
 
 
-def _stage_gen() -> dict:
+def _run_gen(quantization: str | None, prefix: str) -> dict:
     """Generation through the continuous-batching engine at Mistral-7B dims
-    (random bf16 weights on device; numerics irrelevant to throughput).
+    (random weights on device; numerics irrelevant to throughput).
 
     Workload shape follows the reference's production serving pattern
-    (mixed prompt lengths, max_num_seqs >= 32 — ref
-    examples/miscellaneous/multi_gpu_batch_config.yaml: max_num_seqs 128,
-    client batch 16; sampling defaults ref vllm_backend.py:19-27)."""
+    (mixed prompt lengths; ref examples/miscellaneous/
+    multi_gpu_batch_config.yaml: max_num_seqs 128, client batch 16;
+    sampling defaults ref vllm_backend.py:19-27). bf16 serving fits
+    max_num_seqs=32 beside 13.5 GiB of weights on a 16 GiB v5e; int8
+    weight-only quantization (the TPU answer to the reference's NF4 HF
+    path, huggingface_backend.py:66-77) halves weight HBM and runs the
+    reference's full max_num_seqs=128."""
     import jax
     import numpy as np
 
@@ -125,7 +129,8 @@ def _stage_gen() -> dict:
     )
     from distllm_tpu.models import mistral
 
-    if os.environ.get('DISTLLM_BENCH_SMALL'):
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
         # Smoke-test dims for CPU CI; real runs use the 7B defaults.
         model_cfg = mistral.MistralConfig(
             vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
@@ -133,29 +138,38 @@ def _stage_gen() -> dict:
         )
     else:
         model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
-    params = mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
-    jax.block_until_ready(params)
-    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    n_params = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree.leaves(
+            jax.eval_shape(
+                lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
+            )
+        )
+    )
 
     class _Tok:
         eos_id = None
 
-    # Capacity sized so 7B bf16 weights (13.5 GiB) + paged KV fit one v5e
-    # chip (16 GiB): 480 blocks x 16 tok x 32 L x 8 kv x 128 hd x 2 x bf16
-    # = 0.94 GiB. 24 concurrent seqs at <= 320 tokens never exhaust the
-    # pool, so steady state has no preemption churn.
+    if quantization is None:
+        # bf16: 13.5 GiB weights + 32 seqs x 22 blocks x 2 MiB = 1.4 GiB KV.
+        max_num_seqs, num_blocks, n_prompts = 32, 712, 96
+    else:
+        # int8: ~7 GiB weights frees HBM for the reference's production
+        # batch (max_num_seqs 128).
+        max_num_seqs, num_blocks, n_prompts = 128, 2840, 320
     engine_cfg = EngineConfig(
         block_size=16,
-        # Worst case 24 seqs x blocks_needed(320)=20 = 480, plus the
-        # reserved trash block 0 and a small margin.
-        num_blocks=488,
-        max_num_seqs=24,
+        num_blocks=num_blocks,
+        max_num_seqs=max_num_seqs,
         max_model_len=512,
+        decode_steps=16,
+        pipeline_depth=2,
+        quantization=quantization,
     )
     rng = np.random.default_rng(0)
     prompts = [
         list(rng.integers(1, model_cfg.vocab_size, size=int(n)))
-        for n in rng.integers(32, 192, size=64)
+        for n in rng.integers(32, 192, size=n_prompts)
     ]
     gen_tokens = 128
     sampling = SamplingParams(
@@ -163,15 +177,22 @@ def _stage_gen() -> dict:
     )
 
     # engine.warmup() compiles every (batch, bucket) prefill shape, the KV
-    # scatter, the decode step, and the samplers outside the timed region;
-    # the persistent compilation cache (enabled in main) makes repeat runs
-    # start hot. jax.jit is lazy, so an unavailable Pallas lowering only
-    # surfaces here — probe via warmup and fall back to XLA.
+    # scatter, the fused decode window, and the samplers outside the timed
+    # region; the persistent compilation cache (enabled in main) makes
+    # repeat runs start hot. jax.jit is lazy, so an unavailable Pallas
+    # lowering only surfaces here — probe via warmup and fall back to XLA,
+    # recording WHY the preferred backend was rejected.
     backends = ['xla'] if jax.default_backend() == 'cpu' else ['pallas', 'xla']
     engine = None
+    fallback_reason = None
     for backend in backends:
         engine_cfg.attn_backend = backend
-        candidate = LLMEngine(model_cfg, params, _Tok(), engine_cfg)
+        # Fresh params per candidate: the engine owns (and may delete)
+        # them for destructive HBM optimizations (relayout, quant cleanup).
+        params = mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
+        candidate = LLMEngine(
+            model_cfg, params, _Tok(), engine_cfg, own_params=True
+        )
         try:
             candidate.warmup()
             candidate.generate_ids(
@@ -182,10 +203,13 @@ def _stage_gen() -> dict:
             )
             engine = candidate
             break
-        except Exception:
+        except Exception as exc:
             # Free the failed engine's KV cache before building the
             # fallback: two live caches beside 7B weights would OOM HBM.
+            if backend != backends[-1]:
+                fallback_reason = f'{backend}: {exc!r}'[:400]
             candidate.shutdown()
+            del params
             if backend == backends[-1]:
                 raise
     assert engine is not None
@@ -197,28 +221,47 @@ def _stage_gen() -> dict:
     throughput = n_tokens / elapsed
 
     # Analytic A100 estimate for decode of this model: the roofline is
-    # min(compute, HBM bandwidth). At batch ~24-32, decode is
+    # min(compute, HBM bandwidth). At these batches decode is
     # weight-bandwidth bound: tokens/s ~= batch * BW_eff / model_bytes with
-    # A100-80GB 2.0e12 B/s at 60% efficiency and bf16 weights. (Per-chip,
-    # an A100 has 2.4x the HBM bandwidth and 1.6x the bf16 FLOPs of a v5e,
-    # so ratios here compare silicon, not software.)
+    # A100-80GB 2.0e12 B/s at 60% efficiency and bf16 weights — i.e. the
+    # reference's own vLLM serving dtype at the SAME concurrency. (Per
+    # chip, an A100 has 2.4x the HBM bandwidth and 1.6x the bf16 FLOPs of
+    # a v5e, so ratios compare silicon, not software.)
     flops_per_token = 2 * n_params
     model_bytes = 2 * n_params
-    a100_bw_bound = engine_cfg.max_num_seqs * (2.0e12 * 0.60) / model_bytes
+    a100_bw_bound = max_num_seqs * (2.0e12 * 0.60) / model_bytes
     a100_compute_bound = (312e12 * 0.50) / flops_per_token
     a100_estimate = min(a100_bw_bound, a100_compute_bound)
 
     peak = _chip_peak_flops(jax.devices()[0])
     mfu = throughput * flops_per_token / peak if peak else None
-    return {
-        'gen_metric': 'gen tokens/sec/chip',
-        'gen_value': round(throughput, 2),
-        'gen_unit': 'tok/s',
-        'gen_vs_baseline': round(throughput / a100_estimate, 3),
-        'gen_mfu': round(mfu, 4) if mfu is not None else None,
-        'gen_n_tokens': n_tokens,
-        'gen_attn_backend': engine.config.attn_backend,
+    out = {
+        f'{prefix}metric': 'gen tokens/sec/chip',
+        f'{prefix}value': round(throughput, 2),
+        f'{prefix}unit': 'tok/s',
+        f'{prefix}vs_baseline': round(throughput / a100_estimate, 3),
+        f'{prefix}mfu': round(mfu, 4) if mfu is not None else None,
+        f'{prefix}n_tokens': n_tokens,
+        f'{prefix}attn_backend': engine.config.attn_backend,
+        f'{prefix}batch': max_num_seqs,
+        f'{prefix}decode_steps': engine_cfg.decode_steps,
+        f'{prefix}scheduler_impl': type(engine.sched).__name__,
     }
+    if quantization:
+        out[f'{prefix}quantization'] = quantization
+    if fallback_reason:
+        out[f'{prefix}attn_fallback_reason'] = fallback_reason
+    for key, val in engine.telemetry.items():
+        out[f'{prefix}{key}'] = val
+    return out
+
+
+def _stage_gen() -> dict:
+    return _run_gen(None, 'gen_')
+
+
+def _stage_gen_q() -> dict:
+    return _run_gen('int8', 'gen_int8_')
 
 
 def _chip_peak_flops(device) -> float | None:
@@ -296,7 +339,7 @@ def _run_stage(stage: str, timeout: int) -> dict:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--stage', choices=['embed', 'gen'])
+    parser.add_argument('--stage', choices=['embed', 'gen', 'gen_q'])
     args = parser.parse_args()
 
     # The environment's sitecustomize pins jax_platforms='axon,cpu' at
@@ -325,6 +368,9 @@ def main() -> None:
     if args.stage == 'gen':
         print(json.dumps(_stage_gen()))
         return
+    if args.stage == 'gen_q':
+        print(json.dumps(_stage_gen_q()))
+        return
 
     result: dict = {
         'metric': 'embeddings/sec/chip',
@@ -339,7 +385,8 @@ def main() -> None:
         return
 
     result.update(_run_stage('embed', timeout=1200))
-    result.update(_run_stage('gen', timeout=2400))
+    result.update(_run_stage('gen', timeout=2700))
+    result.update(_run_stage('gen_q', timeout=2700))
     print(json.dumps(result))
 
 

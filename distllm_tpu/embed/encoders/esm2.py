@@ -46,43 +46,84 @@ class Esm2Encoder(JaxEncoder):
 
 
 class EsmCambrianEncoderConfig(BaseConfig):
-    """ESM-Cambrian (reference: ``embed/encoders/esmc.py``).
+    """ESM-Cambrian (reference: ``embed/encoders/esmc.py:28-57``).
 
-    The reference validates the two released ESM-C sizes (960/1152 hidden)
-    and caps sequences at 2048 tokens; this port accepts HF-format ESM
-    checkpoints with those dims.
+    Mirrors the reference's embedding-size validation: the two released
+    sizes map 300M→960 and 600M→1152; fine-tuned checkpoints must set
+    ``embedding_size`` explicitly. Sequences cap at 2048 tokens
+    (ref ``esmc.py:84``).
     """
 
     name: Literal['esmc'] = 'esmc'
-    pretrained_model_name_or_path: str
+    pretrained_model_name_or_path: str = 'EvolutionaryScale/esmc-300m-2024-12'
+    embedding_size: int | None = None
     half_precision: bool = True
     model_max_length: int = 2048
 
+    def resolved_embedding_size(self) -> int:
+        if self.embedding_size is not None:
+            return self.embedding_size
+        sizes = {
+            'EvolutionaryScale/esmc-300m-2024-12': 960,
+            'EvolutionaryScale/esmc-600m-2024-12': 1152,
+        }
+        for name, size in sizes.items():
+            # Accept both registry names and local paths ending in them.
+            if self.pretrained_model_name_or_path.rstrip('/').endswith(
+                name.split('/')[-1]
+            ):
+                return size
+        raise ValueError(
+            f'Invalid model name for ESMC: '
+            f'{self.pretrained_model_name_or_path}. Valid model names are: '
+            f'{", ".join(sizes)}. Or set embedding_size explicitly for a '
+            'fine-tuned model.'
+        )
+
 
 class EsmCambrianEncoder(JaxEncoder):
-    VALID_HIDDEN_SIZES = (960, 1152)
+    """The TRUE ESM-C stack (``models/esmc.py``): fused-LN QKV, QK
+    LayerNorm, SwiGLU, sqrt(L/36) residual scaling — loaded from the
+    ``esm``-package ``.pth`` checkpoint format, NOT the ESM-2/HF layout.
+
+    Output parity note: the reference casts bf16 hidden states to fp16 on
+    the way out (``esmc.py:95-100``); pooled embeddings here leave the
+    fused encode path as fp32, which preserves the same values.
+    """
 
     def __init__(self, config: EsmCambrianEncoderConfig) -> None:
-        hf_cfg = read_hf_config(config.pretrained_model_name_or_path)
-        model_cfg = esm2.Esm2Config.from_hf_config(hf_cfg)
-        if model_cfg.hidden_size not in self.VALID_HIDDEN_SIZES:
-            raise ValueError(
-                f'ESM-C checkpoints have hidden size in '
-                f'{self.VALID_HIDDEN_SIZES}, got {model_cfg.hidden_size}'
-            )
-        model_cfg.dtype = 'bfloat16' if config.half_precision else 'float32'
-        params = esm2.params_from_hf(
-            read_checkpoint(config.pretrained_model_name_or_path), model_cfg
+        from distllm_tpu.models import esmc
+
+        hidden = config.resolved_embedding_size()
+        model_cfg = esmc.EsmcConfig.from_hidden_size(
+            hidden,
+            dtype='bfloat16' if config.half_precision else 'float32',
+            max_position_embeddings=config.model_max_length,
         )
-        tokenizer = HFTokenizer(
-            config.pretrained_model_name_or_path,
-            model_max_length=config.model_max_length,
+        state = read_checkpoint(config.pretrained_model_name_or_path)
+        # Depth comes from the checkpoint itself (robust to distilled or
+        # truncated fine-tunes); released 300M/600M match the canonical 30/36.
+        block_ids = [
+            int(k.split('.')[2])
+            for k in state
+            if k.startswith('transformer.blocks.')
+        ]
+        if not block_ids:
+            raise ValueError(
+                'checkpoint is not in esm-package ESMC layout (no '
+                "'transformer.blocks.*' keys) — ESM-C loads the "
+                'EvolutionaryScale .pth format, not HF/ESM-2 checkpoints'
+            )
+        model_cfg.num_layers = 1 + max(block_ids)
+        params = esmc.params_from_esm(state, model_cfg)
+        tokenizer = esmc.EsmcSequenceTokenizer(
+            model_max_length=config.model_max_length
         )
         super().__init__(
             config=config,
-            apply_fn=esm2.apply,
+            apply_fn=esmc.apply,
             model_cfg=model_cfg,
             params=params,
             tokenizer=tokenizer,
-            embedding_size=model_cfg.hidden_size,
+            embedding_size=hidden,
         )

@@ -5,9 +5,18 @@ The TPU-native replacement for the vLLM offline engine the reference wraps
 
 - **prefill**: one sequence per call, bucketed prompt lengths (jit cache
   stays small), K/V scattered into that sequence's blocks;
-- **decode**: ONE jitted step for the whole running batch at fixed shapes
-  (``max_num_seqs`` slots), paged attention over block tables, per-slot
-  sampling params (temperature / top-p / min-p / greedy);
+- **decode**: ONE jitted dispatch generates a *window* of
+  ``decode_steps`` tokens for the whole running batch at fixed shapes
+  (``max_num_seqs`` slots) — a ``lax.scan`` of fused decode+sample steps
+  in which each sampled token feeds the next step entirely on device
+  (``models/mistral.py decode_loop``). On this environment a host↔device
+  round trip costs ~68 ms (measured, ``scripts/probe_bw.py``), so
+  per-token host syncs — what vLLM's GPU loop tolerates at ~10 µs — are
+  the difference between 184 tok/s and >1000 tok/s here. ``generate_ids``
+  additionally pipelines ``pipeline_depth`` windows: the next window is
+  dispatched before the previous window's tokens are fetched, hiding the
+  round trip entirely; EOS is discovered one window late (bounded token
+  waste, vLLM-style multi-step scheduling makes the same trade);
 - **scheduler**: waiting → running admission under block budget, vLLM-style
   recompute preemption when the pool runs dry mid-decode — implemented as a
   NATIVE C++ core (``distllm_tpu/native/scheduler.cpp`` via
@@ -51,6 +60,11 @@ class SamplingParams:
     stop_token_ids: tuple[int, ...] = ()
 
 
+# Sentinel returned by _dispatch_window when nothing can be dispatched
+# (every running slot's budget is covered by in-flight windows).
+_DRAIN = object()
+
+
 class RequestState(Enum):
     WAITING = 'waiting'
     RUNNING = 'running'
@@ -92,6 +106,12 @@ class EngineConfig(BaseConfig):
     prefer_native_allocator: bool = True
     attn_backend: str = 'xla'  # 'xla' | 'pallas' (TPU decode kernel)
     quantization: str | None = None  # None | 'int8' | 'nf4' (weight-only)
+    # Tokens generated per decode dispatch (the fused lax.scan window).
+    # 1 restores per-token dispatch; >1 amortizes dispatch+sync latency.
+    decode_steps: int = 8
+    # Decode windows in flight during generate_ids (2 hides the
+    # host<->device round trip behind the next window's compute).
+    pipeline_depth: int = 2
     seed: int = 0
 
 
@@ -105,11 +125,18 @@ class LLMEngine:
         tokenizer,
         config: EngineConfig | None = None,
         mesh=None,
+        own_params: bool = False,
     ) -> None:
+        """``own_params=True`` hands the engine ownership of ``params``:
+        destructive HBM optimizations (weight relayout, quantized-source
+        deletion) may delete the caller's buffers. Required to serve 7B
+        bf16 on a 16 GB chip — without it the engine keeps the caller's
+        copies alive and falls back to layout-copying dispatches."""
         self.model_cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
         self.config = config or EngineConfig()
+        self._own_params = own_params
         cfg = self.config
 
         # Tensor parallelism: K/V pages shard over the kv-head dim on the
@@ -131,6 +158,9 @@ class LLMEngine:
             kv_sharding = NamedSharding(mesh, P(None, None, None, 'model'))
             self._replicated = NamedSharding(mesh, P())
 
+        # Lazy: the pool is materialized only after the (transient-heavy)
+        # weight-layout migration below, so migration headroom isn't
+        # squeezed by an idle 1-6 GiB of zeros.
         self.kv = PagedKVCache(
             num_layers=model_cfg.num_layers,
             num_blocks=cfg.num_blocks,
@@ -139,6 +169,7 @@ class LLMEngine:
             head_dim=model_cfg.head_size,
             dtype=model_cfg.dtype,
             sharding=kv_sharding,
+            lazy=True,
         )
         self.max_blocks_per_seq = self.kv.blocks_needed(cfg.max_model_len)
         self.prefill_buckets = bucket_ladder(
@@ -169,9 +200,18 @@ class LLMEngine:
                 quantize_pytree,
             )
 
+            source = self.params
             self.params = quantize_pytree(
                 self.params, mode=cfg.quantization, out_dtype=model.dtype
             )
+            if self._own_params:
+                # quantize_pytree passes small leaves (embeddings, norms)
+                # through UNCHANGED — delete only buffers the quantized
+                # tree no longer references.
+                kept = {id(x) for x in jax.tree.leaves(self.params)}
+                for leaf in jax.tree.leaves(source):
+                    if hasattr(leaf, 'delete') and id(leaf) not in kept:
+                        leaf.delete()
         else:
             def _deq(p):
                 return p
@@ -189,23 +229,186 @@ class LLMEngine:
         self._prefill = jax.jit(prefill_fn)
 
         attn_backend = cfg.attn_backend
-        self._decode = jax.jit(
-            lambda params, ids, pos, k, v, bt, ctx: mistral.decode_step(
-                _deq(params), model, ids, pos, k, v, bt, ctx,
-                attn_backend=attn_backend,
-            ),
-            donate_argnums=(3, 4),
+        num_steps = cfg.decode_steps
+        max_tables = cfg.max_model_len
+
+        def window_fn(
+            params, ids, pos, ctx, k, v, bt, steps_left, temp, top_p, min_p,
+            key,
+        ):
+            return mistral.decode_loop(
+                _deq(params), model, ids, pos, k, v, bt, ctx, steps_left,
+                temp, top_p, min_p, key, num_steps=num_steps,
+                attn_backend=attn_backend, max_table_positions=max_tables,
+            )
+
+        self._decode_window = jax.jit(window_fn, donate_argnums=(4, 5))
+        self.telemetry: dict[str, str] = {}
+        if (
+            self._own_params
+            and mesh is None
+            and jax.devices()[0].platform == 'tpu'
+        ):
+            # Let XLA pick the weight layouts the decode loop wants and
+            # store the params that way at rest. Without this, XLA inserts
+            # layout-conversion copies of the stacked q/k/v kernels (1.5 GB
+            # at 7B dims) inside every window dispatch — enough to overflow
+            # a v5e's HBM next to the weights, and pure wasted bandwidth.
+            # Prefill is layout-agnostic (measured:
+            # scripts/probe_prefill_layout.py — 0.13 GiB temp either way),
+            # so the migrated layout serves every executable.
+            compiled = formats = None
+            try:
+                compiled, formats = self._compile_auto_layout(window_fn)
+            except Exception as exc:  # pragma: no cover - TPU-only path
+                self.telemetry['auto_layout_fallback'] = repr(exc)[:300]
+            if compiled is not None:
+                # Destructive from here on (source leaves are deleted as
+                # they migrate); failures are fatal, not a fallback —
+                # callers rebuild with fresh params (see bench.py ladder).
+                self.params = self._migrate_params(formats)
+                self._decode_window = compiled
+        self.kv.allocate()
+        # Merge host-known overrides (fresh admissions) into the device-
+        # carried last-token vector between pipelined windows.
+        self._merge_ids = jax.jit(
+            lambda carried, mask, vals: jnp.where(mask, vals, carried)
         )
         self._write_prefill = jax.jit(
             _write_prefill_all_layers, donate_argnums=(0, 1)
         )
         self._sample = jax.jit(sample_tokens)
+        # Tokens dispatched on device but not yet fetched, per request —
+        # the pipelined path's lag bookkeeping.
+        self._unacked: dict[int, int] = {}
 
     def _put(self, x):
         """Host value → device array, replicated over the mesh under TP."""
         if self._replicated is not None:
             return jax.device_put(x, self._replicated)
         return jnp.asarray(x)
+
+    def _compile_auto_layout(self, window_fn):
+        """AOT-compile the decode window with ``Layout.AUTO`` for params.
+
+        Non-destructive: returns ``(compiled_window, chosen_formats)``;
+        the caller decides whether to run the destructive migration.
+        """
+        from jax.experimental.layout import Format, Layout
+
+        b = self.config.max_num_seqs
+        sds = jax.ShapeDtypeStruct
+        i32 = jnp.int32
+        f32 = jnp.float32
+
+        def spec(tree):
+            return jax.tree.map(lambda x: sds(x.shape, x.dtype), tree)
+
+        shapes = (
+            spec(self.params),
+            sds((b,), i32),  # ids
+            sds((b,), i32),  # positions
+            sds((b,), i32),  # context_lens
+            self.kv.spec(),
+            self.kv.spec(),
+            sds((b, self.max_blocks_per_seq), i32),
+            sds((b,), i32),  # steps_left
+            sds((b,), f32),
+            sds((b,), f32),
+            sds((b,), f32),
+            spec(jax.random.PRNGKey(0)),
+        )
+        jitted = jax.jit(
+            window_fn,
+            donate_argnums=(4, 5),
+            in_shardings=(Format(Layout.AUTO),) + (Format(),) * 11,
+        )
+        compiled = jitted.lower(*shapes).compile()
+        return compiled, compiled.input_formats[0][0]
+
+    def _migrate_params(self, formats):
+        """Move weights into ``formats`` leaf-by-leaf, deleting each source
+        buffer as it lands so peak HBM stays ~one-largest-leaf above the
+        weights (a whole-tree device_put would transiently need 2x).
+
+        Destructive: a mid-migration failure (e.g. HBM fragmentation)
+        leaves already-migrated leaves deleted, so it raises — the engine
+        is not usable with half-deleted params and callers must rebuild.
+        """
+        from jax.experimental.layout import Format
+        from jax.sharding import SingleDeviceSharding
+
+        sharding = SingleDeviceSharding(jax.devices()[0])
+
+        def _sync(array) -> None:
+            # block_until_ready is a no-op on this backend; fetching one
+            # element is the only reliable completion barrier.
+            np.asarray(jax.jit(lambda a: jnp.ravel(a)[:1])(array))
+
+        flat_params, treedef = jax.tree.flatten(self.params)
+        flat_formats = treedef.flatten_up_to(formats)
+        migrated = []
+        moved_bytes = 0
+        # Device-side relayout needs source + target live at once; for the
+        # stacked MLP kernels (3.8 GiB each at 7B dims) that overflows HBM
+        # beside the rest of the weights, so big leaves bounce through host
+        # RAM instead (~1 s each over the link — one-time at startup).
+        bounce_limit = 1 << 30
+        try:
+            for leaf, fmt in zip(flat_params, flat_formats):
+                # input_formats carry layouts without concrete shardings;
+                # device_put requires both.
+                fmt = Format(fmt.layout, sharding)
+                nbytes = getattr(leaf, 'nbytes', 0)
+                on_device = isinstance(leaf, jax.Array)
+                if on_device and nbytes > bounce_limit:
+                    # Fetch in slices along dim 0 (a single multi-GiB d2h
+                    # exhausts the backend's staging memory), free the
+                    # source, then rebuild ON DEVICE: the target buffer is
+                    # created directly in the final layout and filled
+                    # slice-by-slice with donated updates — device_put of
+                    # a whole non-default-layout tensor stages BOTH a
+                    # default-layout upload and a relayout copy (2x the
+                    # tensor), which overflows HBM beside 7B weights.
+                    host = np.empty(leaf.shape, leaf.dtype)
+                    for i in range(leaf.shape[0]):
+                        host[i] = np.asarray(leaf[i])
+                    leaf.delete()
+                    moved = jax.jit(
+                        lambda shape=leaf.shape, dtype=leaf.dtype: jnp.zeros(
+                            shape, dtype
+                        ),
+                        out_shardings=fmt,
+                    )()
+                    fill = jax.jit(
+                        lambda buf, part, idx: jax.lax.dynamic_update_index_in_dim(
+                            buf, part, idx, 0
+                        ),
+                        donate_argnums=0,
+                        out_shardings=fmt,
+                    )
+                    for i in range(host.shape[0]):
+                        moved = fill(moved, host[i], np.int32(i))
+                    del host
+                    _sync(moved)
+                else:
+                    moved = jax.device_put(leaf, fmt)
+                    if hasattr(leaf, 'delete') and moved is not leaf:
+                        leaf.delete()
+                    # Bound the transient: deletes only land once the
+                    # async relayout copies complete, so sync every ~1 GiB.
+                    moved_bytes += nbytes
+                    if moved_bytes > (1 << 30):
+                        _sync(moved)
+                        moved_bytes = 0
+                migrated.append(moved)
+        except Exception as exc:
+            raise RuntimeError(
+                f'weight layout migration failed after {len(migrated)}/'
+                f'{len(flat_params)} leaves; params are partially deleted — '
+                'rebuild the engine with fresh params'
+            ) from exc
+        return jax.tree.unflatten(treedef, migrated)
 
     def warmup(self) -> None:
         """Compile every serving shape outside the request path.
@@ -246,17 +449,30 @@ class LLMEngine:
                     break
                 b *= 2
         bsz = self.config.max_num_seqs
-        logits, self.kv.k, self.kv.v = self._decode(
+        # Warm the fused decode window: steps_left = 0 freezes every slot,
+        # so all KV writes land in the trash block and no state advances.
+        tokens, self.kv.k, self.kv.v, _ = self._decode_window(
             self.params,
             self._put(np.zeros((bsz,), np.int32)),
             self._put(np.zeros((bsz,), np.int32)),
+            self._put(np.ones((bsz,), np.int32)),
             self.kv.k,
             self.kv.v,
             self._put(np.zeros((bsz, self.max_blocks_per_seq), np.int32)),
-            self._put(np.ones((bsz,), np.int32)),
+            self._put(np.zeros((bsz,), np.int32)),
+            self._put(np.zeros((bsz,), np.float32)),
+            self._put(np.ones((bsz,), np.float32)),
+            self._put(np.zeros((bsz,), np.float32)),
+            jax.random.PRNGKey(0),
         )
-        self._sample_batch(logits, [None] * bsz)
-        jax.block_until_ready(self.kv.k)
+        self._merge_ids(
+            self._put(np.zeros((bsz,), np.int32)),
+            self._put(np.zeros((bsz,), bool)),
+            self._put(np.zeros((bsz,), np.int32)),
+        )
+        # On this backend block_until_ready does not synchronize; a tiny
+        # host fetch is the only reliable completion barrier.
+        np.asarray(tokens)
         self._key = saved_key
 
     # ------------------------------------------------------------- requests
@@ -395,22 +611,79 @@ class LLMEngine:
     def _block_row(self, rid: int) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
         blocks = self.sched.block_row(rid)
-        row[: len(blocks)] = blocks
+        # Window reservation (batch-max kmax, up to pipeline_depth x
+        # decode_steps tokens) may overshoot max_model_len by a few blocks;
+        # those blocks are never addressed (positions stay < max_model_len)
+        # so the row safely truncates.
+        n = min(len(blocks), self.max_blocks_per_seq)
+        row[:n] = blocks[:n]
         return row
 
     # --------------------------------------------------------------- decode
     def step(self) -> list[tuple[int, int]]:
-        """One engine iteration. Returns [(request_id, new_token)] emitted."""
+        """One synchronous engine iteration: admit, then generate a window
+        of up to ``decode_steps`` tokens per running sequence.
+
+        Returns [(request_id, new_token)] in emission order. ``generate_ids``
+        does NOT call this — it runs the pipelined loop that keeps
+        ``pipeline_depth`` windows in flight; ``step`` is the simple API for
+        interactive callers (chat server streaming, tests).
+        """
         emitted = self._admit()
         if self.sched.num_running == 0:
             return emitted
+        window = self._dispatch_window(None)
+        if window is not _DRAIN:
+            emitted.extend(self._process_window(window))
+        return emitted
 
-        # The scheduler guarantees every running sequence a block for its
-        # next token, preempting the youngest on OOM (recompute preemption:
-        # output_ids stay intact, so results and token budgets are
-        # unaffected; the request re-prefills on re-admission).
+    def _window_budget(self, request: Request, unacked: int, k: int) -> int:
+        """Tokens this request may still generate in a new window, after
+        accounting for unfetched device-side tokens."""
+        budget = min(
+            request.params.max_tokens - len(request.output_ids) - unacked,
+            self.config.max_model_len - request.num_tokens - unacked,
+        )
+        return max(0, min(k, budget))
+
+    def _window_kmax(self) -> int:
+        """Per-sequence reservation target for the next window: inflight
+        (unacked) tokens plus this window's steps, maxed over the batch."""
+        k = self.config.decode_steps
+        kmax = 1
+        for _, rid in self.sched.running():
+            request = self._requests[rid]
+            unacked = self._unacked.get(rid, 0)
+            kmax = max(kmax, unacked + self._window_budget(request, unacked, k))
+        return kmax
+
+    def _reserve_shortfall(self, kmax: int) -> int:
+        """Blocks ``prepare_decode(kmax)`` would need beyond what running
+        sequences already own — used by the pipelined loop to guarantee no
+        preemption happens while windows are in flight (preempting a
+        sequence whose blocks an in-flight window still writes to would
+        let a re-allocation corrupt another sequence's KV)."""
+        bs = self.config.block_size
+        short = 0
+        for _, rid in self.sched.running():
+            request = self._requests[rid]
+            target = -(-(request.num_tokens + kmax) // bs)
+            short += max(0, target - len(self.sched.block_row(rid)))
+        return short
+
+    def _dispatch_window(self, carried_ids) -> dict | object:
+        """Plan and dispatch one fused decode window (no host sync).
+
+        ``carried_ids`` is the previous window's device-side last-token
+        vector (None = build fully from host knowledge). Slots with no
+        unacked tokens are overridden from host state — fresh admissions,
+        reused slots, or a drained pipeline. Returns the in-flight window
+        record, or ``_DRAIN`` when every running slot's budget is already
+        covered by in-flight windows (caller should process one).
+        """
+        k = self.config.decode_steps
         try:
-            preempted = self.sched.prepare_decode()
+            preempted = self.sched.prepare_decode(self._window_kmax())
         except SchedulerExhausted as exc:
             # Preemptions performed before the fatal exhaustion are not
             # rolled back; sync their states so a caller that catches and
@@ -419,47 +692,147 @@ class LLMEngine:
                 self._requests[rid].state = RequestState.WAITING
             raise
         for rid in preempted:
+            # The pipelined loop drains in-flight windows before any
+            # dispatch that could preempt, so victims never have unacked
+            # device-side tokens; recompute preemption re-prefills them.
             self._requests[rid].state = RequestState.WAITING
-        # O(max_num_seqs) slot-table read, not a scan of every queued request.
         running = [
             (slot, self._requests[rid]) for slot, rid in self.sched.running()
         ]
         if not running:
-            return emitted
+            return _DRAIN
 
         b = self.config.max_num_seqs
         ids = np.zeros((b,), np.int32)
         positions = np.zeros((b,), np.int32)
-        block_tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
         context_lens = np.ones((b,), np.int32)
-        slot_requests: list[Request | None] = [None] * b
+        block_tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        steps_left = np.zeros((b,), np.int32)
+        temperature = np.zeros((b,), np.float32)
+        top_p = np.ones((b,), np.float32)
+        min_p = np.zeros((b,), np.float32)
+        override_mask = np.zeros((b,), bool)
+        plan: list[tuple[int, int, int]] = []
+        any_steps = False
         for slot, request in running:
-            last = (
-                request.output_ids[-1]
-                if request.output_ids
-                else request.prompt_ids[-1]
-            )
-            ids[slot] = last
-            positions[slot] = request.num_tokens - 1
-            block_tables[slot] = self._block_row(request.request_id)
-            context_lens[slot] = request.num_tokens
-            slot_requests[slot] = request
+            rid = request.request_id
+            unacked = self._unacked.get(rid, 0)
+            steps = self._window_budget(request, unacked, k)
+            total = request.num_tokens + unacked
+            positions[slot] = total - 1
+            context_lens[slot] = total
+            block_tables[slot] = self._block_row(rid)
+            steps_left[slot] = steps
+            temperature[slot] = request.params.temperature
+            top_p[slot] = request.params.top_p
+            min_p[slot] = request.params.min_p
+            if unacked == 0:
+                ids[slot] = (
+                    request.output_ids[-1]
+                    if request.output_ids
+                    else request.prompt_ids[-1]
+                )
+                override_mask[slot] = True
+            plan.append((slot, rid, steps))
+            any_steps = any_steps or steps > 0
+        if not any_steps:
+            return _DRAIN
 
-        logits, self.kv.k, self.kv.v = self._decode(
+        if carried_ids is None:
+            ids_dev = self._put(ids)
+        else:
+            ids_dev = self._merge_ids(
+                carried_ids, self._put(override_mask), self._put(ids)
+            )
+        self._key, key = jax.random.split(self._key)
+        tokens, self.kv.k, self.kv.v, last_ids = self._decode_window(
             self.params,
-            self._put(ids),
+            ids_dev,
             self._put(positions),
+            self._put(context_lens),
             self.kv.k,
             self.kv.v,
             self._put(block_tables),
-            self._put(context_lens),
+            self._put(steps_left),
+            self._put(temperature),
+            self._put(top_p),
+            self._put(min_p),
+            key,
         )
-        tokens = self._sample_batch(logits, slot_requests)
-        for slot, request in running:
-            token = int(tokens[slot])
-            self._emit_token(request, token)
-            emitted.append((request.request_id, token))
+        for _, rid, steps in plan:
+            if steps:
+                self._unacked[rid] = self._unacked.get(rid, 0) + steps
+        return {'tokens': tokens, 'plan': plan, 'last_ids': last_ids}
+
+    def _process_window(self, window: dict) -> list[tuple[int, int]]:
+        """Fetch one window's tokens (the only host sync in the decode
+        path) and fold them into request state; post-EOS overshoot tokens
+        are discarded."""
+        tokens = np.asarray(window['tokens'])  # [K, B]
+        emitted: list[tuple[int, int]] = []
+        for slot, rid, steps in window['plan']:
+            if rid in self._unacked:
+                self._unacked[rid] = max(0, self._unacked[rid] - steps)
+            if rid not in self._requests:
+                continue  # finished in an earlier window; overshoot tokens
+            request = self._requests[rid]
+            if request.state is not RequestState.RUNNING:
+                continue  # preempted while idle; will re-prefill
+            for i in range(steps):
+                token = int(tokens[i, slot])
+                self._emit_token(request, token)
+                emitted.append((rid, token))
+                if rid not in self._requests:
+                    break  # finished mid-window
         return emitted
+
+    def _run_to_completion(self) -> None:
+        """Drive all requests to completion with ``pipeline_depth`` decode
+        windows in flight, so the ~68 ms host↔device round trip is hidden
+        behind the next window's compute. EOS and admission react one
+        window late — bounded overshoot, unchanged results."""
+        from collections import deque
+
+        depth = max(1, self.config.pipeline_depth)
+        inflight: deque[dict] = deque()
+        carried = None
+
+        def process_one() -> None:
+            self._process_window(inflight.popleft())
+
+        try:
+            while self.has_unfinished or inflight:
+                self._admit()
+                if self.sched.num_running == 0:
+                    if inflight:
+                        process_one()
+                    continue
+                # Never let a dispatch preempt while windows are in flight.
+                while inflight and (
+                    self._reserve_shortfall(self._window_kmax())
+                    > self.sched.num_free_blocks
+                ):
+                    process_one()
+                window = self._dispatch_window(carried)
+                if window is _DRAIN:
+                    if inflight:
+                        process_one()
+                    continue
+                carried = window['last_ids']
+                inflight.append(window)
+                if len(inflight) >= depth:
+                    process_one()
+        except BaseException:
+            # Keep catch-and-continue recovery sound (the SchedulerExhausted
+            # contract): fold every dispatched window back into request
+            # state so no _unacked counts or device-side tokens are orphaned.
+            while inflight:
+                try:
+                    process_one()
+                except Exception:
+                    inflight.clear()
+                    self._unacked.clear()
+            raise
 
     def _sample_batch(self, logits: jnp.ndarray, slots) -> np.ndarray:
         b = logits.shape[0]
@@ -502,6 +875,7 @@ class LLMEngine:
     def _finish(self, request: Request) -> None:
         request.state = RequestState.FINISHED
         self.sched.finish(request.request_id)
+        self._unacked.pop(request.request_id, None)
         del self._requests[request.request_id]
         self._finished[request.request_id] = request
 
@@ -513,8 +887,7 @@ class LLMEngine:
     ) -> list[list[int]]:
         """Offline batch API: token ids in, generated token ids out."""
         ids = [self.add_request(p, params) for p in prompts]
-        while self.has_unfinished:
-            self.step()
+        self._run_to_completion()
         outs = []
         for rid in ids:
             request = self._finished.pop(rid)

@@ -137,23 +137,40 @@ class PagedKVCache:
         head_dim: int,
         dtype: str = 'bfloat16',
         sharding=None,
+        lazy: bool = False,
     ) -> None:
-        shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
-        if sharding is None:
-            self.k = jnp.zeros(shape, dtype=jnp.dtype(dtype))
-            self.v = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+        self.shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+        self.dtype = jnp.dtype(dtype)
+        self._sharding = sharding
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.k = None
+        self.v = None
+        if not lazy:
+            self.allocate()
+
+    def allocate(self) -> None:
+        """Materialize the pool arrays (``lazy=True`` defers this so the
+        engine can run transient-heavy weight migrations first)."""
+        if self.k is not None:
+            return
+        if self._sharding is None:
+            self.k = jnp.zeros(self.shape, dtype=self.dtype)
+            self.v = jnp.zeros(self.shape, dtype=self.dtype)
         else:
             # Allocate directly into the sharded layout: under tensor
             # parallelism num_blocks is sized against AGGREGATE HBM, so a
             # transient full-size allocation on one device would OOM.
             zeros = jax.jit(
-                lambda: jnp.zeros(shape, dtype=jnp.dtype(dtype)),
-                out_shardings=sharding,
+                lambda: jnp.zeros(self.shape, dtype=self.dtype),
+                out_shardings=self._sharding,
             )
             self.k = zeros()
             self.v = zeros()
-        self.block_size = block_size
-        self.num_blocks = num_blocks
+
+    def spec(self):
+        """ShapeDtypeStruct for one pool array (AOT compilation input)."""
+        return jax.ShapeDtypeStruct(self.shape, self.dtype)
 
     def blocks_needed(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size

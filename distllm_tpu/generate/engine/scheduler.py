@@ -14,9 +14,10 @@ Policy contract (both implementations, tested in lockstep):
 
 - ``admit_next`` pops the waiting-queue head into the lowest free slot when
   blocks for ``num_tokens + 1`` are available (all-or-nothing).
-- ``prepare_decode`` guarantees every running sequence can take one more
-  token, preempting the youngest (highest rid) on OOM — recompute
-  preemption: blocks freed, request to the FRONT of the waiting queue.
+- ``prepare_decode(k)`` guarantees every running sequence can take ``k``
+  more tokens (k > 1 backs multi-step fused decode windows), preempting
+  the youngest (highest rid) on OOM — recompute preemption: blocks freed,
+  request to the FRONT of the waiting queue.
 - Block 0 is the reserved trash block and is never allocated.
 """
 
@@ -47,7 +48,7 @@ class Scheduler(Protocol):
 
     def admit_next(self) -> int | None: ...
 
-    def prepare_decode(self) -> list[int]: ...
+    def prepare_decode(self, k: int = 1) -> list[int]: ...
 
     def append_token(self, rid: int) -> None: ...
 
@@ -144,7 +145,9 @@ class PyScheduler:
             req.blocks.append(self._free.pop())
         return True
 
-    def prepare_decode(self) -> list[int]:
+    def prepare_decode(self, k: int = 1) -> list[int]:
+        if k < 1:
+            raise ValueError('k must be >= 1')
         preempted: list[int] = []
         for rid in list(self._slots):
             if rid < 0:
@@ -152,7 +155,7 @@ class PyScheduler:
             req = self._requests[rid]
             if req.slot < 0:
                 continue  # preempted earlier in this loop
-            while not self._extend(req, req.num_tokens + 1):
+            while not self._extend(req, req.num_tokens + k):
                 victim = self._preempt_youngest()
                 if victim is None:
                     raise SchedulerExhausted(
@@ -222,9 +225,10 @@ class NativeScheduler:
         lib.sched_add.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
         lib.sched_admit_next.restype = ctypes.c_int64
         lib.sched_admit_next.argtypes = [ctypes.c_void_p]
-        lib.sched_prepare_decode.restype = ctypes.c_int32
-        lib.sched_prepare_decode.argtypes = [
+        lib.sched_prepare_decode_k.restype = ctypes.c_int32
+        lib.sched_prepare_decode_k.argtypes = [
             ctypes.c_void_p,
+            ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int64),
         ]
         for name in ('sched_append_token', 'sched_finish'):
@@ -282,9 +286,11 @@ class NativeScheduler:
             )
         return None if rid < 0 else rid
 
-    def prepare_decode(self) -> list[int]:
+    def prepare_decode(self, k: int = 1) -> list[int]:
+        if k < 1:
+            raise ValueError('k must be >= 1')
         out = (ctypes.c_int64 * self._max_num_seqs)()
-        n = int(self._lib.sched_prepare_decode(self._handle, out))
+        n = int(self._lib.sched_prepare_decode_k(self._handle, k, out))
         if n < 0:
             # Fatal encoding is -(1 + n_preempted): preemptions already
             # performed are not rolled back and must reach the engine.

@@ -12,6 +12,12 @@ from distllm_tpu.generate.generators.api_backend import (
     ApiGeneratorConfig,
 )
 from distllm_tpu.generate.generators.base import LLMGenerator
+from distllm_tpu.generate.generators.chat_endpoints import (
+    ArgoGenerator,
+    ArgoGeneratorConfig,
+    OpenAIAPIGenerator,
+    OpenAIAPIGeneratorConfig,
+)
 from distllm_tpu.generate.generators.huggingface_backend import (
     HuggingFaceGenerator,
     HuggingFaceGeneratorConfig,
@@ -28,6 +34,8 @@ GeneratorConfigs = Union[
     TpuGeneratorConfig,
     HuggingFaceGeneratorConfig,
     ApiGeneratorConfig,
+    ArgoGeneratorConfig,
+    OpenAIAPIGeneratorConfig,
     FakeGeneratorConfig,
 ]
 
@@ -37,6 +45,8 @@ STRATEGIES: dict[str, tuple[type, type]] = {
     'huggingface': (HuggingFaceGeneratorConfig, HuggingFaceGenerator),
     'api': (ApiGeneratorConfig, ApiGenerator),
     'langchain': (ApiGeneratorConfig, ApiGenerator),  # reference-config alias
+    'argo': (ArgoGeneratorConfig, ArgoGenerator),
+    'openai': (OpenAIAPIGeneratorConfig, OpenAIAPIGenerator),
     'fake': (FakeGeneratorConfig, FakeGenerator),
 }
 

@@ -1,0 +1,185 @@
+"""Dedicated Argo-proxy and direct-OpenAI chat generators.
+
+Reference parity: ``distllm/chat_argoproxy.py:216-352`` — beyond the generic
+:class:`ApiGenerator`, the reference ships two specialized clients with
+distinct conventions:
+
+- :class:`ArgoGenerator` — Argonne's Argo proxy: ``argo:`` model names, an
+  api key that "can be any string", env-default ``MODEL``/``BASE_URL``,
+  ``/v1`` appended to the base URL, a ``user`` field injected into each
+  request (the proxy's attribution convention), and errors returned as
+  ``"Error: ..."`` strings rather than raised (``:244-257``).
+- :class:`OpenAIAPIGenerator` — the public OpenAI API: api key REQUIRED at
+  construction (``:293-298``), ``max_completion_tokens`` instead of the
+  legacy ``max_tokens`` (``:320-326``), and explicit handling of
+  None/empty-content responses (``:328-343``).
+
+Both expose the framework-wide ``generate(prompts) -> list[str]`` protocol
+plus the reference's per-call ``temperature``/``max_tokens`` overrides.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Literal
+
+from pydantic import Field
+
+from distllm_tpu.generate.generators.api_backend import ApiAuthError
+from distllm_tpu.utils import BaseConfig, expo_backoff_retry
+
+_SYSTEM = 'You are a helpful assistant.'
+
+
+class _ChatEndpointBase:
+    """Shared requests plumbing for the two endpoint flavors."""
+
+    def _post(self, url: str, headers: dict, body: dict) -> dict:
+        import requests
+
+        def call() -> dict:
+            response = requests.post(
+                url, json=body, headers=headers, timeout=self.config.timeout
+            )
+            if response.status_code in (401, 403):
+                # Retrying cannot fix bad credentials — fail fast (the
+                # reference still surfaces this as an 'Error: ...' string).
+                raise ApiAuthError(f'{response.status_code} from {url}')
+            response.raise_for_status()
+            return response.json()
+
+        return expo_backoff_retry(
+            call, max_tries=self.config.max_tries, give_up_on=(ApiAuthError,)
+        )
+
+    def _generate_many(self, prompts, temperature, max_tokens) -> list[str]:
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        return [self._one(p, temperature, max_tokens) for p in prompts]
+
+
+class ArgoGeneratorConfig(BaseConfig):
+    name: Literal['argo'] = 'argo'
+    model: str = Field(
+        default_factory=lambda: os.getenv('MODEL', 'argo:gpt-4o'),
+        description='Argo-proxy model name.',
+    )
+    base_url: str = Field(
+        default_factory=lambda: os.getenv('BASE_URL', 'http://localhost:56267'),
+        description='Argo proxy base URL (``/v1`` is appended).',
+    )
+    api_key: str = Field(
+        default='whatever+random',
+        description='Argo accepts any string as the key.',
+    )
+    user: str = Field(
+        default_factory=lambda: os.getenv('USER', 'distllm'),
+        description='Injected into each request body — the Argo proxy '
+        'attributes usage per user.',
+    )
+    temperature: float = 0.0
+    max_tokens: int = 16384
+    timeout: float = 300.0
+    max_tries: int = 3
+
+
+class ArgoGenerator(_ChatEndpointBase):
+    """Chat generator against an Argo proxy (ref ``:216-257``)."""
+
+    def __init__(self, config: ArgoGeneratorConfig) -> None:
+        self.config = config
+
+    def _one(self, prompt, temperature=None, max_tokens=None) -> str:
+        cfg = self.config
+        body = {
+            'model': cfg.model,
+            'messages': [
+                {'role': 'system', 'content': _SYSTEM},
+                {'role': 'user', 'content': prompt},
+            ],
+            'temperature': cfg.temperature if temperature is None else temperature,
+            'max_tokens': cfg.max_tokens if max_tokens is None else max_tokens,
+            'user': cfg.user,
+        }
+        headers = {
+            'Content-Type': 'application/json',
+            'Authorization': f'Bearer {cfg.api_key}',
+        }
+        url = f'{cfg.base_url.rstrip("/")}/v1/chat/completions'
+        try:
+            payload = self._post(url, headers, body)
+            return payload['choices'][0]['message']['content']
+        except Exception as exc:  # reference returns, not raises (:252-257)
+            print(f'Error calling Argo proxy: {exc}')
+            return f'Error: {exc!s}'
+
+    def generate(
+        self, prompts, temperature=None, max_tokens=None
+    ) -> list[str]:
+        return self._generate_many(prompts, temperature, max_tokens)
+
+
+class OpenAIAPIGeneratorConfig(BaseConfig):
+    name: Literal['openai'] = 'openai'
+    model: str = Field(
+        default_factory=lambda: os.getenv('OPENAI_MODEL', 'gpt-4.1')
+    )
+    api_key: str = Field(
+        default_factory=lambda: os.getenv('OPENAI_API_KEY', ''),
+    )
+    base_url: str | None = Field(
+        default_factory=lambda: os.getenv('OPENAI_BASE_URL', None),
+        description='Optional override (e.g. Azure).',
+    )
+    temperature: float = 0.0
+    max_tokens: int = 16384
+    timeout: float = 300.0
+    max_tries: int = 3
+
+
+class OpenAIAPIGenerator(_ChatEndpointBase):
+    """Direct OpenAI API client (ref ``:284-352``)."""
+
+    def __init__(self, config: OpenAIAPIGeneratorConfig) -> None:
+        if not config.api_key:
+            raise ValueError(
+                'OpenAI API key is required. Set OPENAI_API_KEY environment '
+                'variable or provide it in the config file.'
+            )
+        self.config = config
+
+    def _one(self, prompt, temperature=None, max_tokens=None) -> str:
+        cfg = self.config
+        body = {
+            'model': cfg.model,
+            'messages': [
+                {'role': 'system', 'content': _SYSTEM},
+                {'role': 'user', 'content': prompt},
+            ],
+            'temperature': cfg.temperature if temperature is None else temperature,
+            # Current-generation models reject the legacy max_tokens field.
+            'max_completion_tokens': (
+                cfg.max_tokens if max_tokens is None else max_tokens
+            ),
+        }
+        headers = {
+            'Content-Type': 'application/json',
+            'Authorization': f'Bearer {cfg.api_key}',
+        }
+        base = (cfg.base_url or 'https://api.openai.com/v1').rstrip('/')
+        try:
+            payload = self._post(f'{base}/chat/completions', headers, body)
+            choice = payload['choices'][0]
+            content = choice['message'].get('content')
+            if content is None:  # ref :328-336
+                reason = choice.get('finish_reason')
+                return f'[No content returned. Finish reason: {reason}]'
+            return content
+        except Exception as exc:
+            print(f'Error calling OpenAI API: {exc}')
+            return f'Error: {exc}'
+
+    def generate(
+        self, prompts, temperature=None, max_tokens=None
+    ) -> list[str]:
+        return self._generate_many(prompts, temperature, max_tokens)

@@ -102,6 +102,9 @@ class TpuGenerator:
                 quantization=quant_mode,
             ),
             mesh=mesh,
+            # The generator created these params itself; let the engine
+            # apply destructive HBM optimizations (relayout/quant cleanup).
+            own_params=True,
         )
 
     def _sampling_params(self) -> SamplingParams:

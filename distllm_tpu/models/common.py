@@ -18,11 +18,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float) -> jnp.ndarray:
+def layer_norm(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray | None,
+    eps: float,
+) -> jnp.ndarray:
+    """LayerNorm; ``bias=None`` = scale-only (ESM-C's bias-free norms)."""
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     normed = (x - mean) * jax.lax.rsqrt(var + eps)
-    return normed * scale + bias
+    out = normed * scale
+    if bias is not None:
+        out = out + bias
+    return out
 
 
 def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:

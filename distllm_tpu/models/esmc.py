@@ -1,0 +1,248 @@
+"""ESM-Cambrian (ESM-C) protein language model — the true architecture.
+
+Reference parity: ``distllm/embed/encoders/esmc.py:28-134`` wraps
+EvolutionaryScale's ``esm`` package (``esm.models.esmc.ESMC``); that stack
+is NOT ESM-2-shaped, so this module implements it directly in JAX:
+
+- fused pre-norm QKV: LayerNorm → one ``d→3d`` linear (no bias);
+- **QK LayerNorm** on the full q/k vectors before head split (scale only);
+- rotary position embeddings (rotate-half convention, theta 10000);
+- bidirectional attention masked on key validity (no causal mask);
+- SwiGLU FFN with hidden ``ceil(8/3·d / 256)·256`` (2560 @ 960, 3072 @ 1152);
+- residuals divided by ``sqrt(num_layers / 36)``;
+- final LayerNorm; embeddings output = the normed last hidden state.
+
+Released sizes (the two the reference validates): 300M = 960 hidden /
+30 layers / 15 heads; 600M = 1152 / 36 / 18. Checkpoint conversion reads
+the ``esm`` package's state-dict naming (``transformer.blocks.N.attn.
+layernorm_qkv...``). Numerics are golden-tested against an independent
+NumPy re-implementation (``tests/test_esmc.py``) — real released weights
+cannot be fetched in this environment (zero egress).
+
+The tokenizer mirrors ``EsmSequenceTokenizer``: the fixed 33-symbol protein
+vocabulary (cls/pad/eos/unk + amino acids + specials), cls+seq+eos framing,
+2048-token cap (ref ``esmc.py:84``).
+"""
+
+from __future__ import annotations
+
+from typing import Literal, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distllm_tpu.models import common
+from distllm_tpu.models.tokenizer import TokenBatch, _BucketingMixin, bucket_ladder
+from distllm_tpu.utils import BaseConfig
+
+# EsmSequenceTokenizer's vocabulary (fixed, public).
+ESMC_VOCAB = (
+    ['<cls>', '<pad>', '<eos>', '<unk>']
+    + list('LAGVSERTIDPKQNFYMHWCXBUZO')
+    + ['.', '-', '|', '<mask>']
+)
+
+_SIZES = {960: (30, 15), 1152: (36, 18)}
+
+
+class EsmcConfig(BaseConfig):
+    name: Literal['esmc'] = 'esmc'
+    vocab_size: int = 64  # embedding rows are padded past the 33 used ids
+    hidden_size: int = 960
+    num_layers: int = 30
+    num_heads: int = 15
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dtype: str = 'bfloat16'
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_hidden(self) -> int:
+        # swiglu_correction_fn: 8/3 expansion rounded up to multiple of 256.
+        return int(-(-(self.hidden_size * 8 // 3) // 256) * 256)
+
+    @property
+    def residue_scale(self) -> float:
+        return float(np.sqrt(self.num_layers / 36.0))
+
+    @classmethod
+    def from_hidden_size(cls, hidden_size: int, **kwargs) -> 'EsmcConfig':
+        if hidden_size not in _SIZES:
+            raise ValueError(
+                f'ESM-C hidden size must be one of {sorted(_SIZES)} '
+                f'(300M/600M releases), got {hidden_size}'
+            )
+        layers, heads = _SIZES[hidden_size]
+        return cls(
+            hidden_size=hidden_size,
+            num_layers=layers,
+            num_heads=heads,
+            **kwargs,
+        )
+
+
+def init(rng: jax.Array, cfg: EsmcConfig) -> dict:
+    h, f = cfg.hidden_size, cfg.ffn_hidden
+    scale = 0.02
+
+    def normal(key, shape):
+        return np.asarray(jax.random.normal(key, shape) * scale, np.float32)
+
+    keys = jax.random.split(rng, 2)
+    layers = []
+    for li in range(cfg.num_layers):
+        ks = jax.random.split(jax.random.fold_in(keys[0], li), 4)
+        layers.append(
+            {
+                'qkv_ln': {'scale': np.ones((h,), np.float32),
+                           'bias': np.zeros((h,), np.float32)},
+                'qkv': {'kernel': normal(ks[0], (h, 3 * h))},
+                'q_ln': {'scale': np.ones((h,), np.float32)},
+                'k_ln': {'scale': np.ones((h,), np.float32)},
+                'out': {'kernel': normal(ks[1], (h, h))},
+                'ffn_ln': {'scale': np.ones((h,), np.float32),
+                           'bias': np.zeros((h,), np.float32)},
+                'ffn_in': {'kernel': normal(ks[2], (h, 2 * f))},
+                'ffn_out': {'kernel': normal(ks[3], (f, h))},
+            }
+        )
+    return {
+        'embed': normal(keys[1], (cfg.vocab_size, h)),
+        'layers': common.stack_layers(layers),
+        'final_ln': {'scale': np.ones((h,), np.float32)},
+    }
+
+
+def apply(
+    params: dict,
+    cfg: EsmcConfig,
+    input_ids: jnp.ndarray,  # [B, S]
+    attention_mask: jnp.ndarray,  # [B, S]
+) -> jnp.ndarray:
+    """Forward → last hidden states ``[B, S, H]`` (after the final norm —
+    exactly what the reference's ``encode`` returns as embeddings)."""
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = input_ids.shape
+    eps = cfg.layer_norm_eps
+    cos, sin = common.rope_frequencies(cfg.head_size, s, cfg.rope_theta)
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    inv_scale = jnp.asarray(1.0 / cfg.residue_scale, dtype)
+    # Bidirectional attention over valid keys only.
+    mask = attention_mask[:, None, None, :].astype(bool)
+
+    x = jnp.asarray(params['embed'])[input_ids].astype(dtype)
+
+    def ln(h, p, with_bias=True):
+        # Norm statistics in fp32 (same discipline as the ESM-2 stack).
+        return common.layer_norm(
+            h.astype(jnp.float32),
+            p['scale'],
+            p['bias'] if with_bias else None,
+            eps,
+        ).astype(dtype)
+
+    def layer(x, lp):
+        normed = ln(x, lp['qkv_ln'])
+        qkv = common.dense(normed, lp['qkv']['kernel'])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # QK LayerNorm on the FULL vectors, scale-only, before head split.
+        q = ln(q, lp['q_ln'], with_bias=False)
+        k = ln(k, lp['k_ln'], with_bias=False)
+        q = common.split_heads(q, cfg.num_heads)
+        k = common.split_heads(k, cfg.num_heads)
+        v = common.split_heads(v, cfg.num_heads)
+        q = common.apply_rope(q, cos, sin)
+        k = common.apply_rope(k, cos, sin)
+        attn = common.sdpa(q, k, v, mask=mask)
+        x = x + common.dense(
+            common.merge_heads(attn), lp['out']['kernel']
+        ) * inv_scale
+        normed2 = ln(x, lp['ffn_ln'])
+        gate_up = common.dense(normed2, lp['ffn_in']['kernel'])
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+        ffn = common.dense(common.silu(gate) * up, lp['ffn_out']['kernel'])
+        return x + ffn * inv_scale, None
+
+    x, _ = jax.lax.scan(layer, x, params['layers'])
+    return ln(x, params['final_ln'], with_bias=False)
+
+
+def params_from_esm(state: dict[str, np.ndarray], cfg: EsmcConfig) -> dict:
+    """Convert an ``esm``-package ESMC state dict (``.pth``) to our tree."""
+    def lin(key):
+        return {'kernel': np.ascontiguousarray(state[key].T)}
+
+    def ln(prefix, with_bias=True):
+        out = {'scale': state[f'{prefix}.weight']}
+        if with_bias:
+            bias = state.get(f'{prefix}.bias')
+            out['bias'] = (
+                bias
+                if bias is not None
+                else np.zeros_like(out['scale'])
+            )
+        return out
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f'transformer.blocks.{i}'
+        layers.append(
+            {
+                'qkv_ln': ln(f'{p}.attn.layernorm_qkv.0'),
+                'qkv': lin(f'{p}.attn.layernorm_qkv.1.weight'),
+                'q_ln': ln(f'{p}.attn.q_ln', with_bias=False),
+                'k_ln': ln(f'{p}.attn.k_ln', with_bias=False),
+                'out': lin(f'{p}.attn.out_proj.weight'),
+                'ffn_ln': ln(f'{p}.ffn.0'),
+                'ffn_in': lin(f'{p}.ffn.1.weight'),
+                'ffn_out': lin(f'{p}.ffn.3.weight'),
+            }
+        )
+    return {
+        'embed': state['embed.weight'],
+        'layers': common.stack_layers(layers),
+        'final_ln': ln('transformer.norm', with_bias=False),
+    }
+
+
+class EsmcSequenceTokenizer(_BucketingMixin):
+    """``EsmSequenceTokenizer`` equivalent: fixed protein vocab, cls+seq+eos
+    framing, bucketed fixed-shape padding (TPU requirement)."""
+
+    def __init__(self, model_max_length: int = 2048, min_bucket: int = 16):
+        self.vocab = list(ESMC_VOCAB)
+        self.vocab_size = len(self.vocab)
+        self._ids = {tok: i for i, tok in enumerate(self.vocab)}
+        self.pad_id = self._ids['<pad>']
+        self.cls_id = self._ids['<cls>']
+        self.eos_id = self._ids['<eos>']
+        self.unk_id = self._ids['<unk>']
+        self.model_max_length = model_max_length
+        self.buckets = bucket_ladder(model_max_length, min_bucket)
+
+    def __call__(
+        self, texts: Sequence[str], *, max_length: int | None = None
+    ) -> TokenBatch:
+        max_length = max_length or self.model_max_length
+        body_limit = max(0, max_length - 2)
+        rows = []
+        for seq in texts:
+            body = [
+                self._ids.get(ch, self.unk_id) for ch in seq.upper().strip()
+            ]
+            rows.append([self.cls_id] + body[:body_limit] + [self.eos_id])
+        return self._pad_to_bucket(rows, self.pad_id, max_length)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        out = []
+        for tid in ids:
+            tok = self.vocab[int(tid)] if 0 <= int(tid) < self.vocab_size else '<unk>'
+            if tok.startswith('<'):
+                continue
+            out.append(tok)
+        return ''.join(out)

@@ -31,7 +31,13 @@ def read_checkpoint(model_dir: str | Path) -> dict[str, np.ndarray]:
         for path in safetensor_files:
             state.update(load_file(str(path)))
         return state
-    bin_files = sorted(model_dir.glob('*.bin')) + sorted(model_dir.glob('*.pt'))
+    bin_files = (
+        sorted(model_dir.glob('*.bin'))
+        + sorted(model_dir.glob('*.pt'))
+        # esm-package checkpoints (ESM-C) ship as .pth, nested under
+        # data/weights/ in the released repos.
+        + sorted(model_dir.glob('**/*.pth'))
+    )
     if bin_files:
         import torch
 

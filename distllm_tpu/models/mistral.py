@@ -266,34 +266,28 @@ def _forward(
     return hidden, None, None
 
 
-def decode_step(
+def _decode_core(
     params: dict,
     cfg: MistralConfig,
-    input_ids: jnp.ndarray,  # [B] one new token per sequence
-    positions: jnp.ndarray,  # [B] 0-based index of that token
-    k_cache: jnp.ndarray,  # [L, num_blocks, block_size, N_kv, Hd]
+    input_ids: jnp.ndarray,  # [B]
+    positions: jnp.ndarray,  # [B]
+    k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, max_blocks]
-    context_lens: jnp.ndarray,  # [B] valid tokens incl. the new one
-    attn_backend: str = 'xla',
+    context_lens: jnp.ndarray,  # [B]
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    attn_backend: str,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Single-token decode over the paged KV cache.
-
-    Returns ``(logits [B, V] fp32, k_cache, v_cache)`` with the new token's
-    K/V written into the paged blocks. Inactive batch slots should point
-    their block table rows at the reserved trash block 0.
-
-    ``attn_backend`` selects the XLA gather baseline or the Pallas kernel;
-    sliding-window checkpoints (``cfg.sliding_window``) force the XLA path,
-    which applies the same window mask as prefill.
-    """
+    """One decode step's compute, RoPE tables passed in (so a multi-step
+    scan hoists them out of the loop)."""
     from distllm_tpu.ops.paged_attention import (
         paged_attention_pallas,
         paged_attention_xla,
         write_token_kv,
     )
 
-    if cfg.sliding_window is not None or attn_backend == 'xla':
+    if attn_backend == 'xla':
 
         def attend(q, k_cache_l, v_cache_l):
             return paged_attention_xla(
@@ -304,15 +298,24 @@ def decode_step(
 
         def attend(q, k_cache_l, v_cache_l):
             return paged_attention_pallas(
-                q, k_cache_l, v_cache_l, block_tables, context_lens
+                q, k_cache_l, v_cache_l, block_tables, context_lens,
+                sliding_window=cfg.sliding_window,
             )
 
     dtype = jnp.dtype(cfg.dtype)
-    cos, sin = _rope_tables(cfg, cfg.max_position_embeddings)
     x = jnp.asarray(params['embed'])[input_ids].astype(dtype)  # [B, H]
 
-    def layer(x, xs):
-        lp, k_cache_l, v_cache_l = xs
+    # The FULL caches ride the scan carry and each layer dynamic-update-
+    # slices its own [num_blocks, bs, Nkv, Hd] plane in place — XLA aliases
+    # while-loop carries, so no second cache copy is ever materialized.
+    # (Scanning the caches as xs/ys instead allocates a full stacked output
+    # buffer: +1 GB at 7B dims, and one more when a multi-step window scan
+    # wraps this — that overflowed the v5e's 16 GB HBM.)
+    def layer(carry, xs):
+        x, k_cache, v_cache = carry
+        lp, li = xs
+        k_cache_l = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
+        v_cache_l = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
         normed = common.rms_norm(x, lp['attn_ln']['scale'], cfg.rms_norm_eps)
         q = common.dense(normed, lp['q']['kernel']).reshape(
             -1, cfg.num_heads, cfg.head_size
@@ -339,13 +342,120 @@ def decode_step(
             * common.dense(normed2, lp['up']['kernel']),
             lp['down']['kernel'],
         )
-        return x + mlp, (k_cache_l, v_cache_l)
+        k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k_cache_l, li, 0)
+        v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v_cache_l, li, 0)
+        return (x + mlp, k_cache, v_cache), None
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer, x, (params['layers'], k_cache, v_cache)
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        layer,
+        (x, k_cache, v_cache),
+        (params['layers'], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     hidden = common.rms_norm(x, params['final_ln']['scale'], cfg.rms_norm_eps)
     return logits(params, cfg, hidden), k_cache, v_cache
+
+
+def decode_step(
+    params: dict,
+    cfg: MistralConfig,
+    input_ids: jnp.ndarray,  # [B] one new token per sequence
+    positions: jnp.ndarray,  # [B] 0-based index of that token
+    k_cache: jnp.ndarray,  # [L, num_blocks, block_size, N_kv, Hd]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    context_lens: jnp.ndarray,  # [B] valid tokens incl. the new one
+    attn_backend: str = 'xla',
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Single-token decode over the paged KV cache.
+
+    Returns ``(logits [B, V] fp32, k_cache, v_cache)`` with the new token's
+    K/V written into the paged blocks. Inactive batch slots should point
+    their block table rows at the reserved trash block 0.
+
+    ``attn_backend`` selects the XLA gather baseline or the Pallas kernel
+    (both support sliding-window checkpoints via ``cfg.sliding_window``).
+    """
+    cos, sin = _rope_tables(cfg, cfg.max_position_embeddings)
+    return _decode_core(
+        params, cfg, input_ids, positions, k_cache, v_cache, block_tables,
+        context_lens, cos, sin, attn_backend,
+    )
+
+
+def decode_loop(
+    params: dict,
+    cfg: MistralConfig,
+    input_ids: jnp.ndarray,  # [B] last emitted token per slot
+    positions: jnp.ndarray,  # [B] 0-based index of that token
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] — covers +num_steps tokens
+    context_lens: jnp.ndarray,  # [B] valid tokens incl. the input token
+    steps_left: jnp.ndarray,  # [B] int32 — tokens this slot may emit now
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    min_p: jnp.ndarray,  # [B]
+    key: jax.Array,
+    num_steps: int,
+    attn_backend: str = 'xla',
+    max_table_positions: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``num_steps`` fused decode+sample steps in ONE dispatch.
+
+    The TPU-first answer to the reference's per-token GPU decode loop
+    (vLLM inside ``generate/generators/vllm_backend.py``): on this
+    environment a host↔device round trip costs ~68 ms (measured,
+    ``scripts/probe_bw.py``), so the engine generates a *window* of tokens
+    per dispatch — each step's sampled token feeds the next step's input
+    entirely on device, and only the ``[num_steps, B]`` token block travels
+    to host (asynchronously, once per window).
+
+    Per-slot ``steps_left`` masks slots that run out of budget mid-window
+    (max_tokens / max_model_len): their KV writes are routed to the
+    reserved trash block 0 and their later tokens are garbage the host
+    discards. The scheduler must have reserved blocks for ``min(num_steps,
+    steps_left)`` extra tokens per slot.
+
+    Returns ``(tokens [num_steps, B] int32, k_cache, v_cache, last_ids)``.
+    """
+    from distllm_tpu.ops.sampling import sample_tokens
+
+    # RoPE tables bounded by what positions can actually reach: the block
+    # table row covers max_table_positions tokens (engine max_model_len) —
+    # far smaller than the checkpoint's 32k max_position_embeddings.
+    table_len = max_table_positions or cfg.max_position_embeddings
+    cos, sin = _rope_tables(cfg, table_len)
+
+    def body(carry, step_key):
+        ids, pos, ctx, k_cache, v_cache, live_steps = carry
+        live = live_steps > 0
+        # Out-of-budget slots write to the trash block (row of zeros) and
+        # stop advancing; their sampled tokens are discarded host-side.
+        bt_eff = jnp.where(live[:, None], block_tables, 0)
+        logits_, k_cache, v_cache = _decode_core(
+            params, cfg, ids, pos, k_cache, v_cache, bt_eff, ctx,
+            cos, sin, attn_backend,
+        )
+        token = sample_tokens(logits_, step_key, temperature, top_p, min_p)
+        ids = jnp.where(live, token, ids)
+        pos = jnp.where(live, pos + 1, pos)
+        ctx = jnp.where(live, ctx + 1, ctx)
+        return (ids, pos, ctx, k_cache, v_cache, live_steps - 1), token
+
+    keys = jax.random.split(key, num_steps)
+    (ids, _, _, k_cache, v_cache, _), tokens = jax.lax.scan(
+        body,
+        (
+            input_ids,
+            positions,
+            context_lens,
+            k_cache,
+            v_cache,
+            steps_left.astype(jnp.int32),
+        ),
+        keys,
+    )
+    return tokens, k_cache, v_cache, ids
 
 
 def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray:

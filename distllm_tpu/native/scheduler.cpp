@@ -12,10 +12,12 @@
 // Policy (must stay in lockstep with PyScheduler):
 //   - admit_next: pop the head of the waiting queue into the lowest free
 //     slot if blocks for (num_tokens + 1) are available.
-//   - prepare_decode: every running sequence gets capacity for one more
-//     token; on OOM, preempt the youngest (highest request id) running
-//     request — free its blocks, push it to the FRONT of the waiting
-//     queue (recompute preemption: it will re-prefill prompt + generated).
+//   - prepare_decode(k): every running sequence gets capacity for k more
+//     tokens (k > 1 backs the engine's multi-step fused decode windows,
+//     where K tokens are generated per dispatch); on OOM, preempt the
+//     youngest (highest request id) running request — free its blocks,
+//     push it to the FRONT of the waiting queue (recompute preemption: it
+//     will re-prefill prompt + generated).
 //   - block 0 is the reserved trash block and is never handed out.
 //
 // C ABI for ctypes; no exceptions across the boundary.
@@ -159,7 +161,7 @@ int64_t sched_admit_next(void* h) {
     return rid;
 }
 
-// Ensure every running sequence has block capacity for one more token,
+// Ensure every running sequence has block capacity for `k` more tokens,
 // preempting the youngest on OOM. Preempted rids are written to
 // out_preempted (capacity = max_num_seqs). Returns the preempted count, or
 // -(1 + n_preempted) when the pool is exhausted with a single running
@@ -167,8 +169,11 @@ int64_t sched_admit_next(void* h) {
 // rolled back (their requests sit in the waiting queue), so the caller must
 // read out_preempted[0..n_preempted) and sync its request states before
 // raising.
-int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {
+int32_t sched_prepare_decode_k(void* h, int32_t k, int64_t* out_preempted) {
     auto* s = static_cast<Scheduler*>(h);
+    // INT32_MIN = argument error; must not collide with the fatal-
+    // exhaustion encoding -(1 + n_preempted).
+    if (k < 1) return INT32_MIN;
     int32_t n_preempted = 0;
     std::vector<int64_t> snapshot(s->slots);
     for (int64_t rid : snapshot) {
@@ -176,7 +181,7 @@ int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {
         Request& req = s->requests[rid];
         if (req.slot < 0) continue;  // preempted earlier in this loop
         bool preempted_self = false;
-        while (!s->extend(req, req.num_tokens + 1)) {
+        while (!s->extend(req, req.num_tokens + k)) {
             int64_t victim = s->preempt_youngest();
             if (victim < 0) return -(1 + n_preempted);
             out_preempted[n_preempted++] = victim;
@@ -188,6 +193,10 @@ int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {
         if (preempted_self) continue;
     }
     return n_preempted;
+}
+
+int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {
+    return sched_prepare_decode_k(h, 1, out_preempted);
 }
 
 int32_t sched_append_token(void* h, int64_t rid) {

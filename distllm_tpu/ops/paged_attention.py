@@ -12,11 +12,16 @@ signature:
 
 - :func:`paged_attention_xla` — gather + masked softmax; XLA fuses this well
   and it is the portable baseline (also runs on CPU for tests).
-- :func:`paged_attention_pallas` — Pallas TPU kernel: grid over sequences,
-  block tables scalar-prefetched so each program DMAs exactly its own KV
-  blocks VMEM-side, online-softmax accumulation in fp32.
+- :func:`paged_attention_pallas` — Pallas TPU kernel: grid over
+  (sequence, KV chunk); block tables are scalar-prefetched and each grid
+  step explicitly DMAs its chunk's pages HBM→VMEM with double buffering
+  (issue chunk c+1 while computing chunk c), online-softmax accumulation
+  in fp32 scratch. Chunks that lie entirely outside a sequence's valid
+  window (beyond ``context_lens`` or before the sliding-window start) are
+  skipped: no DMA, no compute.
 
-Both handle GQA (query heads grouped over KV heads) and fp32 softmax.
+Both handle GQA (query heads grouped over KV heads), sliding windows, and
+fp32 softmax.
 """
 
 from __future__ import annotations
@@ -60,62 +65,151 @@ def paged_attention_xla(
 
 
 def _paged_attn_kernel(
-    # scalar-prefetch operands
-    block_tables_ref,  # [B, max_blocks] int32 (SMEM)
-    context_lens_ref,  # [B] int32 (SMEM)
+    # scalar-prefetch operands (SMEM)
+    block_tables_ref,  # [B, max_blocks] int32
+    context_lens_ref,  # [B] int32
     # array operands
     q_ref,  # [num_heads, head_dim] (VMEM) — one sequence
-    k_cache_ref,  # [num_blocks, block_size, num_kv_heads, head_dim] (ANY/HBM)
+    k_cache_ref,  # [num_blocks, block_size, num_kv_heads, head_dim] (HBM)
     v_cache_ref,
-    out_ref,  # [num_heads, head_dim]
+    out_ref,  # [num_heads, head_dim] (VMEM)
+    # scratch
+    k_buf,  # [2, pages_per_chunk, block_size, num_kv_heads, head_dim] VMEM
+    v_buf,
+    sems,  # DMA semaphores [2, pages_per_chunk, 2]
+    acc_ref,  # [num_heads, head_dim] fp32
+    m_ref,  # [num_heads, 1] fp32
+    l_ref,  # [num_heads, 1] fp32
     *,
     block_size: int,
-    max_blocks: int,
+    pages_per_chunk: int,
     num_kv_heads: int,
     group: int,
+    sliding_window: int | None,
 ):
-    """One grid program = one sequence: online softmax over its KV blocks."""
+    """Grid (B, num_chunks): one sequence × one chunk of KV pages per step.
+
+    Pages of a chunk are DMA'd HBM→VMEM individually (they are scattered by
+    the paged allocator), double-buffered across grid steps: while chunk c
+    computes, chunk c+1's copies are in flight. Out-of-range chunks (beyond
+    ``context_lens`` or entirely before the sliding-window start) issue no
+    DMAs and no compute.
+    """
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     seq = pl.program_id(0)
+    c = pl.program_id(1)
+    num_chunks = pl.num_programs(1)
     ctx = context_lens_ref[seq]
+    chunk_tokens = pages_per_chunk * block_size
     num_heads = q_ref.shape[0]
     head_dim = q_ref.shape[1]
-    q = q_ref[...].astype(jnp.float32).reshape(num_kv_heads, group, head_dim)
-    scale = jax.lax.rsqrt(jnp.float32(head_dim))
 
-    def body(i, carry):
-        m, l, acc = carry  # running max, normalizer, weighted values
-        block_id = block_tables_ref[seq, i]
-        k_blk = k_cache_ref[block_id].astype(jnp.float32)  # [bs, Nkv, Hd]
-        v_blk = v_cache_ref[block_id].astype(jnp.float32)
-        scores = (
-            jnp.einsum('kgd,skd->kgs', q, k_blk, preferred_element_type=jnp.float32)
-            * scale
-        )
-        positions = i * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, block_size), 2
-        )
-        scores = jnp.where(positions < ctx, scores, -jnp.inf)
-        blk_max = jnp.max(scores, axis=-1)
-        new_m = jnp.maximum(m, blk_max)
-        # Guard fully-masked blocks: exp(-inf - -inf) -> use finite correction.
-        correction = jnp.exp(jnp.where(m == -jnp.inf, 0.0, m - new_m))
-        probs = jnp.exp(scores - new_m[..., None])
-        probs = jnp.where(jnp.isfinite(scores), probs, 0.0)
-        new_l = l * correction + jnp.sum(probs, axis=-1)
-        new_acc = acc * correction[..., None] + jnp.einsum(
-            'kgs,skd->kgd', probs, v_blk, preferred_element_type=jnp.float32
-        )
-        return new_m, new_l, new_acc
+    # Number of pages this sequence actually uses, and the window floor.
+    n_pages = (ctx + block_size - 1) // block_size
+    if sliding_window is not None:
+        lo = jnp.maximum(ctx - sliding_window, 0)
+    else:
+        lo = jnp.int32(0)
 
-    n_blocks = (ctx + block_size - 1) // block_size
-    m0 = jnp.full((num_kv_heads, group), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((num_kv_heads, group), jnp.float32)
-    acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-9)[..., None]
-    out_ref[...] = out.reshape(num_heads, head_dim).astype(out_ref.dtype)
+    def chunk_needed(ci):
+        start = ci * chunk_tokens
+        return (start < ctx) & ((ci + 1) * chunk_tokens > lo)
+
+    def issue(ci, slot):
+        # Clamp logical page ids into the sequence's valid range: the DMA
+        # engine must copy *something* per issued descriptor, and the
+        # compute mask discards anything outside [lo, ctx).
+        for p in range(pages_per_chunk):
+            logical = ci * pages_per_chunk + p
+            page = jnp.clip(logical, 0, jnp.maximum(n_pages - 1, 0))
+            page_id = block_tables_ref[seq, page]
+            pltpu.make_async_copy(
+                k_cache_ref.at[page_id], k_buf.at[slot, p], sems.at[slot, p, 0]
+            ).start()
+            pltpu.make_async_copy(
+                v_cache_ref.at[page_id], v_buf.at[slot, p], sems.at[slot, p, 1]
+            ).start()
+
+    def wait(slot):
+        for p in range(pages_per_chunk):
+            pltpu.make_async_copy(
+                k_cache_ref.at[0], k_buf.at[slot, p], sems.at[slot, p, 0]
+            ).wait()
+            pltpu.make_async_copy(
+                v_cache_ref.at[0], v_buf.at[slot, p], sems.at[slot, p, 1]
+            ).wait()
+
+    @pl.when(c == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+        @pl.when(chunk_needed(0))
+        def _():
+            issue(0, 0)
+
+    # Double buffering: start chunk c+1's copies before computing chunk c.
+    @pl.when((c + 1 < num_chunks) & chunk_needed(c + 1))
+    def _():
+        issue(c + 1, (c + 1) % 2)
+
+    @pl.when(chunk_needed(c))
+    def _():
+        slot = c % 2
+        wait(slot)
+        scale = jax.lax.rsqrt(jnp.float32(head_dim))
+        kb = k_buf[slot].reshape(chunk_tokens, num_kv_heads, head_dim)
+        vb = v_buf[slot].reshape(chunk_tokens, num_kv_heads, head_dim)
+        positions = c * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk_tokens), 1
+        )
+        valid = positions < ctx
+        if sliding_window is not None:
+            valid = valid & (positions >= lo)
+
+        q = q_ref[...]
+        for h in range(num_kv_heads):  # static unroll over KV heads
+            qh = q[h * group : (h + 1) * group, :]  # [g, Hd]
+            kh = kb[:, h, :]  # [C, Hd]
+            scores = (
+                jax.lax.dot_general(
+                    qh, kh,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [g, C]
+            scores = jnp.where(valid, scores, -jnp.inf)
+            m_h = m_ref[h * group : (h + 1) * group, :]  # [g, 1]
+            blk_max = jnp.max(scores, axis=-1, keepdims=True)
+            new_m = jnp.maximum(m_h, blk_max)
+            correction = jnp.exp(
+                jnp.where(m_h == -jnp.inf, -jnp.inf, m_h - new_m)
+            )
+            probs = jnp.exp(scores - new_m)  # masked lanes: exp(-inf) = 0
+            l_h = l_ref[h * group : (h + 1) * group, :]
+            l_ref[h * group : (h + 1) * group, :] = (
+                l_h * correction + jnp.sum(probs, axis=-1, keepdims=True)
+            )
+            vh = vb[:, h, :]  # [C, Hd]
+            pv = jax.lax.dot_general(
+                probs.astype(vh.dtype), vh,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [g, Hd]
+            acc_h = acc_ref[h * group : (h + 1) * group, :]
+            acc_ref[h * group : (h + 1) * group, :] = (
+                acc_h * correction + pv
+            )
+            m_ref[h * group : (h + 1) * group, :] = new_m
+
+    @pl.when(c == num_chunks - 1)
+    def _():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-9)
+        out_ref[...] = out.astype(out_ref.dtype)
 
 
 def paged_attention_pallas(
@@ -125,9 +219,16 @@ def paged_attention_pallas(
     block_tables: jnp.ndarray,
     context_lens: jnp.ndarray,
     *,
+    sliding_window: int | None = None,
+    pages_per_chunk: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Pallas TPU kernel version of :func:`paged_attention_xla`."""
+    """Pallas TPU kernel version of :func:`paged_attention_xla`.
+
+    ``pages_per_chunk`` controls how many KV pages one grid step fetches
+    and computes (default: enough for 128 tokens) — larger chunks amortize
+    DMA-issue overhead and feed the MXU bigger tiles, at the cost of VMEM.
+    """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -135,25 +236,52 @@ def paged_attention_pallas(
     num_blocks, block_size, num_kv_heads, _ = k_cache.shape
     max_blocks = block_tables.shape[1]
     group = num_heads // num_kv_heads
+    if head_dim % 128 and not interpret:
+        # Mosaic requires HBM DMA slices 128-aligned in the minor dim; the
+        # engine probes this at warmup and falls back to the XLA path.
+        raise ValueError(
+            f'pallas paged attention needs head_dim % 128 == 0, got {head_dim}'
+        )
+    if pages_per_chunk is None:
+        pages_per_chunk = max(1, 128 // block_size)
+    pages_per_chunk = min(pages_per_chunk, max_blocks)
+    num_chunks = -(-max_blocks // pages_per_chunk)
 
     kernel = functools.partial(
         _paged_attn_kernel,
         block_size=block_size,
-        max_blocks=max_blocks,
+        pages_per_chunk=pages_per_chunk,
         num_kv_heads=num_kv_heads,
         group=group,
+        sliding_window=sliding_window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b,),
+        grid=(b, num_chunks),
         in_specs=[
-            pl.BlockSpec((None, num_heads, head_dim), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(
+                (None, num_heads, head_dim), lambda i, j, *_: (i, 0, 0)
+            ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (None, num_heads, head_dim), lambda i, *_: (i, 0, 0)
+            (None, num_heads, head_dim), lambda i, j, *_: (i, 0, 0)
         ),
+        scratch_shapes=[
+            pltpu.VMEM(
+                (2, pages_per_chunk, block_size, num_kv_heads, head_dim),
+                k_cache.dtype,
+            ),
+            pltpu.VMEM(
+                (2, pages_per_chunk, block_size, num_kv_heads, head_dim),
+                v_cache.dtype,
+            ),
+            pltpu.SemaphoreType.DMA((2, pages_per_chunk, 2)),
+            pltpu.VMEM((num_heads, head_dim), jnp.float32),
+            pltpu.VMEM((num_heads, 1), jnp.float32),
+            pltpu.VMEM((num_heads, 1), jnp.float32),
+        ],
     )
     return pl.pallas_call(
         kernel,

@@ -5,6 +5,12 @@ Reference parity: vLLM ``SamplingParams`` as configured by
 top_p XOR min_p; greedy when temperature == 0). All filtering happens on
 fp32 logits; each sequence carries its own parameters so one decode batch can
 mix sampling configs (continuous batching requirement).
+
+This runs INSIDE the engine's fused decode scan (one sample per decode
+step), so it is written for the TPU hot path: a single descending sort
+serves the top-p cutoff, and min-p is applied as a pure log-space
+comparison (``prob >= min_p * max_prob  <=>  logit >= max_logit +
+log(min_p)``) — no softmax materialization, no second sort.
 """
 
 from __future__ import annotations
@@ -13,25 +19,17 @@ import jax
 import jax.numpy as jnp
 
 
-def _apply_top_p(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
-    """Nucleus filtering per row; ``top_p >= 1`` disables."""
-    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+def _top_p_from_sorted(
+    logits: jnp.ndarray, sorted_desc: jnp.ndarray, top_p: jnp.ndarray
+) -> jnp.ndarray:
+    sorted_probs = jax.nn.softmax(sorted_desc, axis=-1)
     cumulative = jnp.cumsum(sorted_probs, axis=-1)
     # Keep the smallest prefix with cumulative >= top_p (always >= 1 token).
     cutoff_idx = jnp.sum(cumulative < top_p[:, None], axis=-1)
     cutoff_logit = jnp.take_along_axis(
-        sorted_logits, cutoff_idx[:, None], axis=-1
+        sorted_desc, cutoff_idx[:, None], axis=-1
     )
     keep = logits >= cutoff_logit
-    return jnp.where(keep, logits, -jnp.inf)
-
-
-def _apply_min_p(logits: jnp.ndarray, min_p: jnp.ndarray) -> jnp.ndarray:
-    """Keep tokens with prob >= min_p * max_prob; ``min_p <= 0`` disables."""
-    probs = jax.nn.softmax(logits, axis=-1)
-    threshold = min_p[:, None] * jnp.max(probs, axis=-1, keepdims=True)
-    keep = probs >= threshold
     return jnp.where(keep, logits, -jnp.inf)
 
 
@@ -44,11 +42,18 @@ def sample_tokens(
 ) -> jnp.ndarray:
     """Per-sequence sampling; temperature == 0 rows are greedy."""
     logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1)
 
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_temp[:, None]
-    scaled = _apply_top_p(scaled, top_p)
-    scaled = _apply_min_p(scaled, min_p)
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    greedy = jnp.argmax(logits, axis=-1)
+
+    filtered = _top_p_from_sorted(scaled, sorted_desc, top_p)
+    # min-p in log space: prob >= min_p * max_prob is equivalent to
+    # logit >= max_logit + log(min_p); log(0) = -inf disables the filter.
+    max_logit = sorted_desc[:, :1]
+    min_p_threshold = max_logit + jnp.log(jnp.maximum(min_p, 0.0))[:, None]
+    filtered = jnp.where(scaled >= min_p_threshold, filtered, -jnp.inf)
+
+    sampled = jax.random.categorical(key, filtered, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

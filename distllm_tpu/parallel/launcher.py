@@ -110,12 +110,190 @@ class PodConfig(BaseConfig):
         return ZmqPoolExecutor(coordinator)
 
 
-ComputeConfigs = Union[LocalConfig, WorkstationConfig, PodConfig]
+class _BatchSchedulerConfig(BaseConfig):
+    """Shared knobs for scheduler-submitted pods (reference: the PBSPro /
+    Slurm providers in ``distllm/parsl.py:106-252`` — account, queue,
+    walltime, worker_init, scheduler_options, retries, heartbeats).
+
+    ``get_executor`` starts the ZMQ coordinator in THIS process (the
+    reference's interchange also stays on the login node), renders a job
+    script that boots one ``distllm_tpu.parallel.worker`` per pod host
+    dialing back to it, and submits the script. ``submit=False`` renders
+    without submitting (dry runs, CI).
+    """
+
+    account: str
+    queue: str
+    walltime: str = '01:00:00'
+    num_nodes: int = 1
+    worker_init: str = Field(
+        default='',
+        description='Shell run on each host before the worker starts '
+        '(module loads, venv activation, TPU env vars).',
+    )
+    scheduler_options: str = Field(
+        default='',
+        description='Extra verbatim #PBS/#SBATCH directive lines.',
+    )
+    coordinator_port: int = 5555
+    advertise_host: str | None = None
+    retries: int = 1
+    heartbeat_threshold: float = 120.0
+    submit: bool = True
+
+    def _worker_command(self, endpoint: str) -> str:
+        return (
+            'python -m distllm_tpu.parallel.worker '
+            f'--coordinator {endpoint}'
+        )
+
+    def render_script(self, endpoint: str, run_dir: Path) -> str:
+        raise NotImplementedError
+
+    def _submit_command(self, script_path: Path) -> list[str]:
+        raise NotImplementedError
+
+    @property
+    def _script_name(self) -> str:
+        raise NotImplementedError
+
+    def get_executor(self, run_dir: str | Path):
+        import subprocess
+
+        from distllm_tpu.parallel.fabric import Coordinator, ZmqPoolExecutor
+
+        run_dir = Path(run_dir)
+        run_dir.mkdir(parents=True, exist_ok=True)
+        coordinator = Coordinator(
+            bind=f'tcp://*:{self.coordinator_port}',
+            retries=self.retries,
+            heartbeat_threshold=self.heartbeat_threshold,
+            advertise_host=self.advertise_host,
+        )
+        script = self.render_script(coordinator.endpoint, run_dir)
+        script_path = run_dir / self._script_name
+        script_path.write_text(script)
+        print(f'[fabric] coordinator at {coordinator.endpoint}', flush=True)
+        if self.submit:
+            proc = subprocess.run(
+                self._submit_command(script_path),
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f'job submission failed ({proc.returncode}): '
+                    f'{proc.stderr.strip()[-500:]}'
+                )
+            print(f'[fabric] submitted job: {proc.stdout.strip()}', flush=True)
+        return ZmqPoolExecutor(coordinator)
+
+
+class TpuPodPbsConfig(_BatchSchedulerConfig):
+    """PBSPro-submitted TPU pod (the Polaris analogue, ref
+    ``parsl.py:106-180``): one fabric worker per pod host via mpiexec."""
+
+    name: Literal['pbspro'] = 'pbspro'
+    select: str = Field(
+        default='',
+        description='Extra -l select resource suffix, e.g. '
+        '":tpu_accelerator=v5e"; rendered as select=<num_nodes><select>.',
+    )
+
+    @property
+    def _script_name(self) -> str:
+        return 'submit.pbs'
+
+    def _submit_command(self, script_path: Path) -> list[str]:
+        return ['qsub', str(script_path)]
+
+    def render_script(self, endpoint: str, run_dir: Path) -> str:
+        lines = [
+            '#!/bin/bash',
+            f'#PBS -A {self.account}',
+            f'#PBS -q {self.queue}',
+            f'#PBS -l walltime={self.walltime}',
+            f'#PBS -l select={self.num_nodes}{self.select}',
+            f'#PBS -o {run_dir}/pbs.out',
+            f'#PBS -e {run_dir}/pbs.err',
+        ]
+        if self.scheduler_options:
+            lines.extend(self.scheduler_options.splitlines())
+        lines += [
+            '',
+            self.worker_init,
+            '',
+            '# One fabric worker per pod host, dialing the coordinator.',
+            f'mpiexec -n {self.num_nodes} --ppn 1 '
+            + self._worker_command(endpoint),
+            '',
+        ]
+        return '\n'.join(lines)
+
+
+class TpuPodSlurmConfig(_BatchSchedulerConfig):
+    """Slurm-submitted TPU pod (the Leonardo analogue, ref
+    ``parsl.py:183-252``): one fabric worker per pod host via srun."""
+
+    name: Literal['slurm'] = 'slurm'
+    partition: str = Field(
+        default='',
+        description='Slurm partition (falls back to queue when empty).',
+    )
+    qos: str = ''
+
+    @property
+    def _script_name(self) -> str:
+        return 'submit.sbatch'
+
+    def _submit_command(self, script_path: Path) -> list[str]:
+        return ['sbatch', str(script_path)]
+
+    def render_script(self, endpoint: str, run_dir: Path) -> str:
+        lines = [
+            '#!/bin/bash',
+            f'#SBATCH --account={self.account}',
+            f'#SBATCH --partition={self.partition or self.queue}',
+            f'#SBATCH --time={self.walltime}',
+            f'#SBATCH --nodes={self.num_nodes}',
+            '#SBATCH --ntasks-per-node=1',
+            f'#SBATCH --output={run_dir}/slurm.out',
+            f'#SBATCH --error={run_dir}/slurm.err',
+        ]
+        if self.qos:
+            lines.append(f'#SBATCH --qos={self.qos}')
+        if self.scheduler_options:
+            lines.extend(self.scheduler_options.splitlines())
+        lines += [
+            '',
+            self.worker_init,
+            '',
+            '# One fabric worker per pod host, dialing the coordinator.',
+            f'srun --ntasks={self.num_nodes} --ntasks-per-node=1 '
+            + self._worker_command(endpoint),
+            '',
+        ]
+        return '\n'.join(lines)
+
+
+ComputeConfigs = Union[
+    LocalConfig,
+    WorkstationConfig,
+    PodConfig,
+    TpuPodPbsConfig,
+    TpuPodSlurmConfig,
+]
 
 
 def get_compute_config(kwargs: dict[str, Any]) -> ComputeConfigs:
     name = kwargs.get('name', 'local')
-    for cls in (LocalConfig, WorkstationConfig, PodConfig):
+    for cls in (
+        LocalConfig,
+        WorkstationConfig,
+        PodConfig,
+        TpuPodPbsConfig,
+        TpuPodSlurmConfig,
+    ):
         if name == cls.model_fields['name'].default:
             return cls(**kwargs)
     raise ValueError(f'Unknown compute config name: {name!r}')

@@ -45,8 +45,22 @@ class BatchedSearchResults:
 
 
 def _load_embeddings_dataset(dataset_dir: str | Path):
-    from datasets import load_from_disk
+    """Load an embeddings dataset; a directory of UUID shard subdirs (the
+    distributed-embedding output layout) is concatenated automatically, so
+    indexes build straight from unmerged multi-shard runs."""
+    from datasets import concatenate_datasets, load_from_disk
 
+    dataset_dir = Path(dataset_dir)
+    if not (dataset_dir / 'dataset_info.json').exists():
+        shards = sorted(
+            p
+            for p in dataset_dir.iterdir()
+            if p.is_dir() and (p / 'dataset_info.json').exists()
+        )
+        if shards:
+            return concatenate_datasets(
+                [load_from_disk(str(p)) for p in shards]
+            )
     return load_from_disk(str(dataset_dir))
 
 
@@ -97,54 +111,125 @@ class TpuIndexV2:
         self._build_or_load()
 
     # ------------------------------------------------------------ building
-    def _build_or_load(self) -> None:
-        if self._index_file.exists():
-            data = np.load(self._index_file)
-            embeddings = data['embeddings']
-        else:
-            embeddings = np.asarray(
-                self.dataset['embeddings'], dtype=np.float32
-            )
-            if self.config.normalize:
-                norms = np.linalg.norm(embeddings, axis=1, keepdims=True)
-                embeddings = embeddings / np.clip(norms, 1e-12, None)
-            if self.config.precision == 'ubinary':
-                embeddings_store = pack_sign_bits(embeddings)
-            else:
-                embeddings_store = embeddings
-            self._index_file.parent.mkdir(parents=True, exist_ok=True)
-            np.savez_compressed(self._index_file, embeddings=embeddings_store)
-            embeddings = embeddings_store
-        self._num_real = embeddings.shape[0]
-        if self.config.precision == 'ubinary':
-            self._packed = jnp.asarray(embeddings)
-            # fp32 copy for rescoring candidates (host-side gather).
-            self._rescore_host = np.asarray(
-                self.dataset['embeddings'], dtype=np.float32
-            )
-            if self.config.normalize:
-                norms = np.linalg.norm(self._rescore_host, axis=1, keepdims=True)
-                self._rescore_host /= np.clip(norms, 1e-12, None)
-            self._corpus = None
-        else:
-            if self.mesh is not None and self.mesh.shape.get('data', 1) > 1:
-                import jax
-                from jax.sharding import NamedSharding, PartitionSpec as P
+    # Rows per build/load chunk: bounds peak host RSS at O(chunk), not
+    # O(corpus) (the reference streams its quantization through a
+    # ProcessPoolExecutor for the same reason, search.py:210-221).
+    _CHUNK_ROWS = 65536
 
-                shards = self.mesh.shape['data']
-                pad = (-embeddings.shape[0]) % shards
-                if pad:
-                    # Zero rows pad to a shardable row count; their indices
-                    # (>= _num_real) are dropped in the search filter.
-                    embeddings = np.concatenate(
-                        [embeddings, np.zeros((pad, embeddings.shape[1]), embeddings.dtype)]
-                    )
-                self._corpus = jax.device_put(
-                    embeddings, NamedSharding(self.mesh, P('data', None))
+    def _chunk(self, lo: int) -> np.ndarray:
+        hi = min(lo + self._CHUNK_ROWS, len(self.dataset))
+        rows = np.asarray(
+            self.dataset[lo:hi]['embeddings'], dtype=np.float32
+        )
+        if self.config.normalize:
+            norms = np.linalg.norm(rows, axis=1, keepdims=True)
+            rows = rows / np.clip(norms, 1e-12, None)
+        return rows
+
+    def _build_shards(self) -> None:
+        """Stream the corpus into per-chunk index shard files.
+
+        Chunks are read, normalized, and (for ubinary) sign-bit packed in a
+        thread pool — numpy releases the GIL, giving the reference's
+        parallel-quantization behavior without pickling the corpus.
+        """
+        import json
+        from concurrent.futures import ThreadPoolExecutor
+
+        shard_dir = self._index_file.parent
+        shard_dir.mkdir(parents=True, exist_ok=True)
+        offsets = list(range(0, len(self.dataset), self._CHUNK_ROWS))
+
+        def build_one(part: int) -> str:
+            rows = self._chunk(offsets[part])
+            if self.config.precision == 'ubinary':
+                rows = pack_sign_bits(rows)
+            name = f'{self._index_file.stem}.part{part:05d}.npy'
+            np.save(shard_dir / name, rows)
+            return name
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            parts = list(pool.map(build_one, range(len(offsets))))
+        meta = {'num_rows': len(self.dataset), 'parts': parts}
+        self._meta_file.write_text(json.dumps(meta))
+
+    def _iter_stored_chunks(self):
+        """Yield index chunks (mmap'd shard parts, or the legacy npz)."""
+        import json
+
+        if self._meta_file.exists():
+            meta = json.loads(self._meta_file.read_text())
+            for name in meta['parts']:
+                yield np.load(self._index_file.parent / name, mmap_mode='r')
+        else:  # legacy single-file layout
+            yield np.load(self._index_file)['embeddings']
+
+    def _build_or_load(self) -> None:
+        import json
+
+        self._meta_file = self._index_file.with_suffix('.meta.json')
+        if self._meta_file.exists():
+            # A stale index (dataset re-embedded since the build) would
+            # silently mis-align rows; rebuild when the row count moved.
+            meta = json.loads(self._meta_file.read_text())
+            if meta.get('num_rows') != len(self.dataset):
+                self._build_shards()
+        elif not self._index_file.exists():
+            self._build_shards()
+        self._num_real = len(self.dataset)
+
+        if self.config.precision == 'ubinary':
+            # Packed bits are corpus/32 bytes — assemble on host, then one
+            # device_put. NO second fp32 host copy: rescore candidates are
+            # gathered per query batch from the arrow-mmap'd dataset.
+            self._packed = jnp.asarray(
+                np.concatenate([np.asarray(c) for c in self._iter_stored_chunks()])
+            )
+            self._corpus = None
+            return
+
+        self._packed = None
+        if self.mesh is not None and self.mesh.shape.get('data', 1) > 1:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # Multi-chip: assemble on host (pod hosts have the RAM), pad to
+            # a shardable row count — padded indices (>= _num_real) are
+            # dropped in the search filter.
+            embeddings = np.concatenate(
+                [np.asarray(c) for c in self._iter_stored_chunks()]
+            )
+            shards = self.mesh.shape['data']
+            pad = (-embeddings.shape[0]) % shards
+            if pad:
+                embeddings = np.concatenate(
+                    [embeddings, np.zeros((pad, embeddings.shape[1]), embeddings.dtype)]
                 )
-            else:
-                self._corpus = jnp.asarray(embeddings)
-            self._packed = None
+            self._corpus = jax.device_put(
+                embeddings, NamedSharding(self.mesh, P('data', None))
+            )
+            return
+
+        # Single device: assemble directly in HBM chunk by chunk via a
+        # donated dynamic-update-slice, so host RSS stays O(chunk).
+        import jax
+
+        update = jax.jit(
+            lambda buf, part, lo: jax.lax.dynamic_update_slice(
+                buf, part, (lo, 0)
+            ),
+            donate_argnums=0,
+        )
+        buf = None
+        lo = 0
+        for chunk in self._iter_stored_chunks():
+            part = np.asarray(chunk, dtype=np.float32)
+            if buf is None:
+                dim = part.shape[1]
+                buf = jnp.zeros((self._num_real, dim), jnp.float32)
+            buf = update(buf, part, lo)
+            lo += part.shape[0]
+        self._corpus = buf
 
     def __len__(self) -> int:
         return len(self.dataset)
@@ -180,8 +265,20 @@ class TpuIndexV2:
         _, cand = hamming_topk(query_bits, self._packed, oversample)
         cand = np.asarray(cand)
         # fp32 rescore of the binary candidates against the full-precision
-        # query (sentence-transformers rescore semantics).
-        cand_vectors = self._rescore_host[cand]  # [B, oversample, H]
+        # query (sentence-transformers rescore semantics). Candidate
+        # vectors come from the arrow-mmap'd dataset per batch — the index
+        # keeps NO fp32 corpus copy (that second copy doubled host RSS in
+        # earlier revisions).
+        flat = cand.reshape(-1)
+        order_back = np.argsort(np.argsort(flat))
+        gathered = np.asarray(
+            self.dataset[np.sort(flat).tolist()]['embeddings'],
+            dtype=np.float32,
+        )[order_back]
+        cand_vectors = gathered.reshape(*cand.shape, -1)
+        if self.config.normalize:
+            norms = np.linalg.norm(cand_vectors, axis=-1, keepdims=True)
+            cand_vectors = cand_vectors / np.clip(norms, 1e-12, None)
         rescored = np.einsum('bh,boh->bo', queries.astype(np.float32), cand_vectors)
         order = np.argsort(-rescored, axis=1)[:, :top_k]
         indices = np.take_along_axis(cand, order, axis=1)

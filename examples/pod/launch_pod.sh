@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Manual pod bring-up (no batch scheduler): start one fabric worker per TPU
+# host, all dialing the driver's coordinator. With PBSPro/Slurm, prefer the
+# `pbspro`/`slurm` compute configs, which render and submit this for you.
+#
+# Usage:
+#   on the driver host : python -m distllm_tpu.distributed_embedding \
+#                          --config my_config.yaml      # compute_config: pod
+#   on each TPU host   : bash examples/pod/launch_pod.sh tcp://driver:5555
+#
+# Or fan out over N hosts from one shell (requires passwordless ssh):
+#   bash examples/pod/launch_pod.sh tcp://driver:5555 host1 host2 host3 ...
+set -euo pipefail
+
+COORDINATOR=${1:?usage: launch_pod.sh tcp://driver:5555 [host ...]}
+shift || true
+
+WORKER_CMD="python -m distllm_tpu.parallel.worker --coordinator ${COORDINATOR}"
+
+if [ $# -eq 0 ]; then
+    exec ${WORKER_CMD}
+fi
+
+for host in "$@"; do
+    echo "[launch_pod] starting worker on ${host}"
+    ssh "${host}" "JAX_PLATFORMS=tpu nohup ${WORKER_CMD} \
+        > /tmp/distllm_worker.log 2>&1 &" &
+done
+wait
+echo "[launch_pod] ${#} workers launched against ${COORDINATOR}"

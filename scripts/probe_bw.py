@@ -1,0 +1,105 @@
+"""Focused probe: HBM bandwidth + per-dispatch overhead on the axon TPU.
+
+NOTE: on the axon backend ``block_until_ready`` returns immediately; the only
+reliable sync is fetching a (tiny) result to host, so every timed op reduces
+to a scalar and the timer ends on ``float(...)``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t(fn, n=10, warmup=3):
+    for _ in range(warmup):
+        fn()
+    start = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - start) / n
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    print(f'device: {dev.device_kind}')
+
+    # Pure round-trip latency: dispatch + scalar fetch.
+    x = jax.device_put(jnp.ones((8, 128), jnp.float32))
+    f1 = jax.jit(lambda a: (a * 1.000001).sum())
+    float(f1(x))
+    print('round trip (tiny op + scalar fetch): %.2f ms' % (1e3 * t(
+        lambda: float(f1(x)))))
+
+    # HBM read bandwidth via reduction.
+    for mb in (64, 512):
+        n = mb * 1024 * 1024 // 2
+        w = jax.device_put(jnp.zeros((n // 1024, 1024), jnp.bfloat16))
+        red = jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))
+        float(red(w))
+        dt = t(lambda: float(red(w)))
+        print(f'sum over {mb} MiB: {1e3*dt:.2f} ms -> {mb/1024/dt:.0f} GB/s')
+        del w
+
+    # Matmul with different M (decode is M=batch).
+    k = 8192
+    w = jax.device_put(jnp.zeros((k, k), jnp.bfloat16))  # 128 MiB
+    for m in (8, 24, 128, 1024):
+        v = jax.device_put(jnp.zeros((m, k), jnp.bfloat16))
+        mm = jax.jit(lambda a, b: (a @ b).astype(jnp.float32).sum())
+        float(mm(v, w))
+        dt = t(lambda: float(mm(v, w)))
+        gb = k * k * 2 / 1e9
+        print(f'[{m},{k}]@[{k},{k}] +sum: {1e3*dt:.2f} ms -> {gb/dt:.0f} GB/s, '
+              f'{2*m*k*k/dt/1e12:.2f} TF/s')
+        del v
+
+    # Dispatch overhead: N separate tiny dispatches, one sync at the end.
+    f = jax.jit(lambda a: a * 1.000001)
+    f(x)
+
+    def sep(n):
+        z = x
+        for _ in range(n):
+            z = f(z)
+        return float(z.sum())
+
+    for n in (1, 10, 50):
+        dt = t(lambda: sep(n), n=5)
+        print(f'{n} chained dispatches + sync: {1e3*dt:.2f} ms '
+              f'({1e3*dt/n:.2f} ms/dispatch)')
+
+    g = jax.jit(
+        lambda a: jax.lax.fori_loop(0, 50, lambda i, z: z * 1.000001, a).sum()
+    )
+    float(g(x))
+    print('fori_loop(50) one dispatch + sync: %.2f ms' % (1e3 * t(
+        lambda: float(g(x)))))
+
+    # Host->device transfer (sync'd by using the value).
+    h = np.zeros((24, 32), np.int32)
+    add = jax.jit(lambda a: a.sum())
+    float(add(jax.device_put(h)))
+    print('h2d (24x32) + use + fetch: %.2f ms' % (1e3 * t(
+        lambda: float(add(jax.device_put(h))))))
+
+    # Donated big-buffer scatter (KV-cache-like), sync via tiny probe output.
+    kv = jax.device_put(jnp.zeros((32, 488, 16, 8, 128), jnp.bfloat16))
+    upd = jax.jit(
+        lambda c: (c.at[:, 1, 0].set(1.0), c[0, 1, 0, 0, 0]),
+        donate_argnums=0,
+    )
+
+    def run_upd():
+        nonlocal kv
+        kv, probe = upd(kv)
+        return float(probe)
+    run_upd()
+    print('donated KV scatter (0.94 GiB buffer): %.2f ms' % (1e3 * t(run_upd)))
+
+
+if __name__ == '__main__':
+    main()

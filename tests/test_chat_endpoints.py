@@ -1,0 +1,136 @@
+"""Argo-proxy and direct-OpenAI generators against a mocked HTTP server
+(reference parity: chat_argoproxy.py:216-352)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from distllm_tpu.generate import get_generator
+from distllm_tpu.generate.generators.chat_endpoints import (
+    ArgoGenerator,
+    ArgoGeneratorConfig,
+    OpenAIAPIGenerator,
+    OpenAIAPIGeneratorConfig,
+)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    requests: list[dict] = []
+    content: str | None = 'mock reply'
+    finish_reason = 'stop'
+
+    def do_POST(self):
+        length = int(self.headers['Content-Length'])
+        body = json.loads(self.rfile.read(length))
+        body['_path'] = self.path
+        body['_auth'] = self.headers.get('Authorization', '')
+        _Handler.requests.append(body)
+        payload = {
+            'choices': [
+                {
+                    'message': {'content': _Handler.content},
+                    'finish_reason': _Handler.finish_reason,
+                }
+            ]
+        }
+        data = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def mock_server():
+    _Handler.requests = []
+    _Handler.content = 'mock reply'
+    server = HTTPServer(('127.0.0.1', 0), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f'http://127.0.0.1:{server.server_port}'
+    server.shutdown()
+
+
+def test_argo_generator(mock_server):
+    gen = ArgoGenerator(
+        ArgoGeneratorConfig(
+            model='argo:gpt-4o', base_url=mock_server, user='alice'
+        )
+    )
+    out = gen.generate('hello argo')
+    assert out == ['mock reply']
+    req = _Handler.requests[0]
+    # /v1 appended, user field injected, system prompt prepended.
+    assert req['_path'] == '/v1/chat/completions'
+    assert req['user'] == 'alice'
+    assert req['model'] == 'argo:gpt-4o'
+    assert req['messages'][0]['role'] == 'system'
+    assert req['messages'][1]['content'] == 'hello argo'
+    assert 'max_tokens' in req
+
+
+def test_argo_per_call_overrides(mock_server):
+    gen = ArgoGenerator(ArgoGeneratorConfig(base_url=mock_server))
+    gen.generate('x', temperature=0.7, max_tokens=12)
+    req = _Handler.requests[-1]
+    assert req['temperature'] == 0.7
+    assert req['max_tokens'] == 12
+
+
+def test_argo_error_returned_not_raised():
+    gen = ArgoGenerator(
+        ArgoGeneratorConfig(
+            base_url='http://127.0.0.1:1', max_tries=1, timeout=0.2
+        )
+    )
+    out = gen.generate('x')
+    assert out[0].startswith('Error:')
+
+
+def test_openai_requires_api_key(monkeypatch):
+    monkeypatch.delenv('OPENAI_API_KEY', raising=False)
+    with pytest.raises(ValueError, match='API key is required'):
+        OpenAIAPIGenerator(OpenAIAPIGeneratorConfig(api_key=''))
+
+
+def test_openai_generator(mock_server):
+    gen = OpenAIAPIGenerator(
+        OpenAIAPIGeneratorConfig(
+            model='gpt-4.1', api_key='sk-test', base_url=mock_server
+        )
+    )
+    out = gen.generate(['q1', 'q2'])
+    assert out == ['mock reply', 'mock reply']
+    req = _Handler.requests[0]
+    # Modern field name + bearer auth.
+    assert 'max_completion_tokens' in req and 'max_tokens' not in req
+    assert req['_auth'] == 'Bearer sk-test'
+
+
+def test_openai_none_content_reports_finish_reason(mock_server):
+    _Handler.content = None
+    _Handler.finish_reason = 'content_filter'
+    gen = OpenAIAPIGenerator(
+        OpenAIAPIGeneratorConfig(api_key='sk-test', base_url=mock_server)
+    )
+    out = gen.generate('q')
+    assert out == ['[No content returned. Finish reason: content_filter]']
+
+
+def test_factory_dispatch(mock_server):
+    gen = get_generator(
+        {'name': 'argo', 'base_url': mock_server, 'user': 'bob'}
+    )
+    assert isinstance(gen, ArgoGenerator)
+    gen2 = get_generator(
+        {'name': 'openai', 'api_key': 'sk-x', 'base_url': mock_server}
+    )
+    assert isinstance(gen2, OpenAIAPIGenerator)

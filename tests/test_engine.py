@@ -427,3 +427,61 @@ def test_engine_quantized_weights_generate():
     )
     assert len(outs[0]) == 6
     assert all(0 <= t < 64 for t in outs[0])
+
+
+def test_engine_decode_steps_variants_match_dense():
+    """K=1 (legacy per-token), K=4, and deep pipelining must all produce
+    the dense greedy reference exactly — EOS overshoot tokens are
+    discarded and budgets respected regardless of window shape."""
+    prompts = [[5, 9, 12], [7, 3, 22, 31, 40, 2, 17]]
+    n = 7  # deliberately not a multiple of any window size
+    ref_cfg, ref_params, ref_engine = _tiny_engine()
+    refs = [
+        _dense_greedy_reference(ref_cfg, ref_params, p, n) for p in prompts
+    ]
+    for steps, depth in ((1, 1), (4, 1), (4, 3), (8, 2)):
+        cfg = mistral.MistralConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, intermediate_size=64, dtype='float32',
+        )
+        params = mistral.init(jax.random.PRNGKey(0), cfg)
+
+        class IdTokenizer:
+            eos_id = None
+
+        engine = LLMEngine(
+            cfg, params, IdTokenizer(),
+            EngineConfig(
+                block_size=4, num_blocks=64, max_num_seqs=4,
+                max_model_len=64, prefer_native_allocator=False,
+                decode_steps=steps, pipeline_depth=depth,
+            ),
+        )
+        outs = engine.generate_ids(
+            prompts, SamplingParams(temperature=0.0, max_tokens=n)
+        )
+        assert outs == refs, f'steps={steps} depth={depth}: {outs} != {refs}'
+
+
+def test_engine_pipelined_preemption_pressure_matches_dense():
+    """A pool too small for all sequences forces recompute preemption mid-
+    pipeline; the drain-before-preempt rule must keep results exact."""
+    cfg, params, engine = _tiny_engine(num_blocks=14, max_num_seqs=3)
+    prompts = [[5, 9, 12], [7, 3, 22, 31], [1, 2, 3, 4, 5]]
+    n = 6
+    outs = engine.generate_ids(
+        prompts, SamplingParams(temperature=0.0, max_tokens=n)
+    )
+    for prompt, out in zip(prompts, outs):
+        assert out == _dense_greedy_reference(cfg, params, prompt, n)
+
+
+def test_engine_max_tokens_below_window():
+    """max_tokens=1 with decode_steps=8: the prefill emits the only token
+    and the window machinery must not emit more."""
+    cfg, params, engine = _tiny_engine()
+    outs = engine.generate_ids(
+        [[5, 9, 12]], SamplingParams(temperature=0.0, max_tokens=1)
+    )
+    assert len(outs[0]) == 1
+    assert outs[0] == _dense_greedy_reference(cfg, params, [5, 9, 12], 1)

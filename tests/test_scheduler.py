@@ -57,13 +57,15 @@ def drive(sched, seed: int, steps: int = 200):
             trace.append(('admit', tuple(admitted)))
         elif action == 2:
             if sched.num_running:
+                # k > 1 exercises the multi-step window reservation path.
+                k = int(rng.integers(1, 6))
                 try:
-                    preempted = sched.prepare_decode()
+                    preempted = sched.prepare_decode(k)
                 except SchedulerExhausted as exc:
                     # Fatal path reports prior same-call preemptions too;
                     # both implementations must agree on them.
                     preempted = ['EXHAUSTED', tuple(exc.preempted)]
-                trace.append(('prepare', tuple(preempted)))
+                trace.append(('prepare', k, tuple(preempted)))
                 for rid in list(live):
                     if sched.slot(rid) >= 0:
                         sched.append_token(rid)
@@ -205,3 +207,43 @@ class TestPolicy:
         s.add(0, 1)
         s.finish(0)
         assert not s.has_unfinished
+
+
+class TestPrepareDecodeK:
+    """Multi-token reservation (the fused decode window's contract)."""
+
+    @pytest.fixture(params=['py', 'native'])
+    def sched_factory(self, request):
+        if request.param == 'native' and not native_available():
+            pytest.skip('no C++ toolchain')
+        cls = PyScheduler if request.param == 'py' else NativeScheduler
+        return cls
+
+    def test_reserves_k_tokens_of_blocks(self, sched_factory):
+        sched = sched_factory(num_blocks=32, block_size=4, max_num_seqs=2)
+        sched.add(0, 6)  # needs 2 blocks for 7 tokens at admission
+        assert sched.admit_next() == 0
+        owned = len(sched.block_row(0))
+        # Reserve 9 more tokens: 6 + 9 = 15 -> ceil(15/4) = 4 blocks.
+        sched.prepare_decode(9)
+        assert len(sched.block_row(0)) == 4
+        assert len(sched.block_row(0)) >= owned
+
+    def test_k_preempts_youngest_on_pressure(self, sched_factory):
+        sched = sched_factory(num_blocks=8, block_size=4, max_num_seqs=2)
+        sched.add(0, 4)
+        sched.add(1, 4)
+        assert sched.admit_next() == 0
+        assert sched.admit_next() == 1
+        # 7 usable blocks; both own 2 (4+1 tokens), 3 free. Reserving 12
+        # more tokens each needs 2 extra blocks per sequence -> the second
+        # extension falls short and the youngest (1) is preempted.
+        preempted = sched.prepare_decode(12)
+        assert preempted == [1]
+        assert sched.slot(1) == -1
+        assert len(sched.block_row(0)) == 4  # ceil((4+12)/4)
+
+    def test_k_invalid_raises(self, sched_factory):
+        sched = sched_factory(num_blocks=8, block_size=4, max_num_seqs=2)
+        with pytest.raises(ValueError):
+            sched.prepare_decode(0)

@@ -179,3 +179,85 @@ def test_retriever_end_to_end(tmp_path):
     batch, _ = retriever.search(['completely different topic here', 'alpha beta gamma delta words'], top_k=1)
     assert batch.total_indices[0][0] == 1
     assert batch.total_indices[1][0] in (0, 2)
+
+
+def test_index_sharded_build_and_reload(tmp_path, rng):
+    """The streaming build writes per-chunk shard files + meta; a reload
+    serves identical results without rebuilding."""
+    from datasets import Dataset
+
+    from distllm_tpu.rag import search as search_mod
+    from distllm_tpu.rag.search import TpuIndexV2, TpuIndexV2Config
+
+    n, h = 50, 16
+    embeddings = rng.normal(size=(n, h)).astype(np.float32)
+    Dataset.from_dict(
+        {'text': [f't{i}' for i in range(n)], 'embeddings': list(embeddings)}
+    ).save_to_disk(str(tmp_path / 'ds'))
+
+    # Force multiple chunks to exercise the streaming path.
+    old = TpuIndexV2._CHUNK_ROWS
+    TpuIndexV2._CHUNK_ROWS = 16
+    try:
+        index = TpuIndexV2(TpuIndexV2Config(dataset_dir=tmp_path / 'ds'))
+        parts = sorted((tmp_path / 'ds' / 'tpu_index').glob('*.part*.npy'))
+        assert len(parts) == 4  # ceil(50/16)
+        q = embeddings[:3]
+        res = index.search(q, top_k=3, score_threshold=-1e9)
+        ref = np.argsort(-(q @ (embeddings / np.linalg.norm(embeddings, axis=1, keepdims=True)).T), axis=1)[:, :3]
+        # reload from the shard files (no dataset rebuild)
+        index2 = TpuIndexV2(TpuIndexV2Config(dataset_dir=tmp_path / 'ds'))
+        res2 = index2.search(q, top_k=3, score_threshold=-1e9)
+        assert res.total_indices == res2.total_indices
+    finally:
+        TpuIndexV2._CHUNK_ROWS = old
+
+
+def test_index_ubinary_no_fp32_copy(tmp_path, rng):
+    """ubinary keeps only packed bits resident; rescore gathers from the
+    arrow dataset and still ranks the true nearest first."""
+    from datasets import Dataset
+
+    from distllm_tpu.rag.search import TpuIndexV2, TpuIndexV2Config
+
+    n, h = 96, 64
+    embeddings = rng.normal(size=(n, h)).astype(np.float32)
+    Dataset.from_dict(
+        {'text': [f't{i}' for i in range(n)], 'embeddings': list(embeddings)}
+    ).save_to_disk(str(tmp_path / 'ds'))
+    index = TpuIndexV2(
+        TpuIndexV2Config(
+            dataset_dir=tmp_path / 'ds', precision='ubinary',
+            rescore_multiplier=8,
+        )
+    )
+    assert not hasattr(index, '_rescore_host')
+    normed = embeddings / np.linalg.norm(embeddings, axis=1, keepdims=True)
+    res = index.search(normed[:5], top_k=1, score_threshold=-1e9)
+    assert [row[0] for row in res.total_indices] == [0, 1, 2, 3, 4]
+
+
+def test_index_builds_from_unmerged_shards(tmp_path, rng):
+    """A directory of UUID shard subdirs (distributed embedding output)
+    concatenates automatically."""
+    from datasets import Dataset
+
+    from distllm_tpu.rag.search import TpuIndexV2, TpuIndexV2Config
+
+    h = 16
+    all_embeddings = []
+    for shard in ('aaa111', 'bbb222'):
+        embeddings = rng.normal(size=(10, h)).astype(np.float32)
+        all_embeddings.append(embeddings)
+        Dataset.from_dict(
+            {
+                'text': [f'{shard}-{i}' for i in range(10)],
+                'embeddings': list(embeddings),
+            }
+        ).save_to_disk(str(tmp_path / 'shards' / shard))
+    index = TpuIndexV2(TpuIndexV2Config(dataset_dir=tmp_path / 'shards'))
+    assert len(index) == 20
+    full = np.concatenate(all_embeddings)
+    normed = full / np.linalg.norm(full, axis=1, keepdims=True)
+    res = index.search(normed[15:16], top_k=1, score_threshold=-1e9)
+    assert res.total_indices[0][0] == 15
