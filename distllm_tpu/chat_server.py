@@ -144,6 +144,9 @@ def build_app(config: ChatAppConfig):
 
 
 def main(argv: list[str] | None = None) -> int:
+    from distllm_tpu.utils import apply_platform_env
+
+    apply_platform_env()
     from aiohttp import web
 
     parser = argparse.ArgumentParser(description=__doc__)

@@ -51,23 +51,19 @@ class JaxEncoder:
         self.embedding_size = embedding_size
         if quantization:
             # Weight-only quantization (reference: NF4 via bitsandbytes,
-            # auto.py:46-56): store int8/nf4 codes in HBM, dequantize to the
-            # compute dtype inside the jitted forward.
-            from distllm_tpu.ops.quantization import (
-                dequantize_pytree,
-                quantize_pytree,
-            )
+            # auto.py:46-56): store int8/nf4 codes in HBM; dequantization
+            # happens per layer inside the jitted forward at the point of
+            # use (common.dense unpacks QTensor leaves riding the layer
+            # scan) — a whole-tree dequant before the forward would
+            # materialize the full float model as HLO temps.
+            from distllm_tpu.ops.quantization import quantize_pytree
 
             params = quantize_pytree(
                 params,
                 mode=quantization,
                 out_dtype=getattr(model_cfg, 'dtype', 'bfloat16'),
             )
-            self._apply = lambda p, ids, mask: apply_fn(
-                dequantize_pytree(p), model_cfg, ids, mask
-            )
-        else:
-            self._apply = lambda p, ids, mask: apply_fn(p, model_cfg, ids, mask)
+        self._apply = lambda p, ids, mask: apply_fn(p, model_cfg, ids, mask)
         self._forward = jax.jit(self._apply)
         self._pooled_cache: dict = {}
         self.params = params

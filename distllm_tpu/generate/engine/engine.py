@@ -193,31 +193,25 @@ class LLMEngine:
 
         if cfg.quantization:
             # Weight-only quantized serving (reference: bnb NF4 in the HF
-            # generator, huggingface_backend.py:66-77): codes live in HBM,
-            # dequant happens inside the compiled step.
-            from distllm_tpu.ops.quantization import (
-                dequantize_pytree as _deq,
-                quantize_pytree,
-            )
+            # generator, huggingface_backend.py:66-77): codes live in HBM;
+            # dequant happens INSIDE the compiled step, per layer, at the
+            # point of use (common.dense unpacks QTensor leaves riding the
+            # layer scan) — never as a whole-tree pass, which would
+            # materialize the full float model as HLO temps.
+            from distllm_tpu.ops.quantization import quantize_pytree
 
-            source = self.params
+            # ``delete_source`` streams the conversion when we own the
+            # buffers: each replaced bf16 leaf is freed BEFORE its codes are
+            # materialized, so HBM peaks at the unquantized weights instead
+            # of weights+codes (which OOMed a 16 GiB v5e at 7B dims).
             self.params = quantize_pytree(
-                self.params, mode=cfg.quantization, out_dtype=model.dtype
+                self.params,
+                mode=cfg.quantization,
+                out_dtype=model.dtype,
+                delete_source=self._own_params,
             )
-            if self._own_params:
-                # quantize_pytree passes small leaves (embeddings, norms)
-                # through UNCHANGED — delete only buffers the quantized
-                # tree no longer references.
-                kept = {id(x) for x in jax.tree.leaves(self.params)}
-                for leaf in jax.tree.leaves(source):
-                    if hasattr(leaf, 'delete') and id(leaf) not in kept:
-                        leaf.delete()
-        else:
-            def _deq(p):
-                return p
 
         def prefill_fn(params, ids, mask, last_pos):
-            params = _deq(params)
             hidden, k, v = mistral.prefill(params, model, ids, mask)
             # Only the last valid position's logits are sampled; computing
             # the lm_head for [B, S, V] would waste MXU time and HBM.
@@ -237,7 +231,7 @@ class LLMEngine:
             key,
         ):
             return mistral.decode_loop(
-                _deq(params), model, ids, pos, k, v, bt, ctx, steps_left,
+                params, model, ids, pos, k, v, bt, ctx, steps_left,
                 temp, top_p, min_p, key, num_steps=num_steps,
                 attn_backend=attn_backend, max_table_positions=max_tables,
             )

@@ -348,6 +348,9 @@ def run_mcqa(config: MCQAConfig) -> dict[str, Any]:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from distllm_tpu.utils import apply_platform_env
+
+    apply_platform_env()
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--config', required=True, type=Path)
     args = parser.parse_args(argv)

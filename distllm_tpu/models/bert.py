@@ -96,17 +96,31 @@ def apply(
     cfg: BertConfig,
     input_ids: jnp.ndarray,
     attention_mask: jnp.ndarray,
+    attn_impl: str = 'auto',
 ) -> jnp.ndarray:
     """Forward pass: ``[B, S]`` ids/mask → ``[B, S, H]`` last hidden states.
 
     Numerics follow HF ``BertModel`` (post-LN residual transformer, absolute
     position embeddings); verified to ~1e-2 in bf16 / 1e-5 in fp32 against
     ``transformers`` in tests/test_models.py.
+
+    ``attn_impl``: ``'auto'`` (Pallas encoder-attention kernel on TPU,
+    XLA SDPA elsewhere — the kernel removes the [B, N, S, S] score
+    materialization that caps the embed hot loop, ops/encoder_attention.py),
+    ``'xla'``, or ``'pallas'``.
     """
     dtype = jnp.dtype(cfg.dtype)
     act = common.ACTIVATIONS[cfg.hidden_act]
     emb = params['embeddings']
     seq_len = input_ids.shape[1]
+    from distllm_tpu.ops.encoder_attention import (
+        encoder_attention,
+        resolve_use_pallas,
+    )
+
+    use_pallas = resolve_use_pallas(
+        attn_impl, seq_len, cfg.hidden_size, cfg.num_heads, cfg.dtype
+    )
 
     x = (
         jnp.asarray(emb['word'])[input_ids]
@@ -118,10 +132,21 @@ def apply(
     key_mask = attention_mask.astype(bool)
 
     def layer(x, lp):
-        q = common.split_heads(common.dense(x, lp['q']['kernel'], lp['q']['bias']), cfg.num_heads)
-        k = common.split_heads(common.dense(x, lp['k']['kernel'], lp['k']['bias']), cfg.num_heads)
-        v = common.split_heads(common.dense(x, lp['v']['kernel'], lp['v']['bias']), cfg.num_heads)
-        attn = common.merge_heads(common.sdpa(q, k, v, mask=key_mask))
+        q = common.dense(x, lp['q']['kernel'], lp['q']['bias'])
+        k = common.dense(x, lp['k']['kernel'], lp['k']['bias'])
+        v = common.dense(x, lp['v']['kernel'], lp['v']['bias'])
+        if use_pallas:
+            # Heads stay packed in the last dim — no transpose materializes.
+            attn = encoder_attention(q, k, v, attention_mask, cfg.num_heads)
+        else:
+            attn = common.merge_heads(
+                common.sdpa(
+                    common.split_heads(q, cfg.num_heads),
+                    common.split_heads(k, cfg.num_heads),
+                    common.split_heads(v, cfg.num_heads),
+                    mask=key_mask,
+                )
+            )
         attn = common.dense(attn, lp['o']['kernel'], lp['o']['bias'])
         # Post-LN residual (BERT): LN(x + sublayer(x)), stats in fp32.
         x = common.layer_norm(

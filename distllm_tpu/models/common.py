@@ -42,8 +42,17 @@ def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (normed * scale.astype(jnp.float32)).astype(dtype)
 
 
-def dense(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray | None = None) -> jnp.ndarray:
-    """``x @ kernel (+ bias)`` with kernel laid out ``[in, out]``."""
+def dense(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None) -> jnp.ndarray:
+    """``x @ kernel (+ bias)`` with kernel laid out ``[in, out]``.
+
+    ``kernel`` may be a quantized :class:`~distllm_tpu.ops.quantization.
+    QTensor` — dequantization happens HERE, at the point of use, so a
+    layer scan over a quantized tree only ever materializes one layer's
+    bf16 weights at a time (dequantizing the whole stack outside the scan
+    costs the full float model in HLO temps and OOMs 7B on 16 GiB HBM).
+    """
+    if hasattr(kernel, 'dequantize'):
+        kernel = kernel.dequantize()
     y = jnp.einsum('...i,io->...o', x, kernel.astype(x.dtype))
     if bias is not None:
         y = y + bias.astype(y.dtype)

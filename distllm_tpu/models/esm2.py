@@ -90,10 +90,26 @@ def apply(
     cfg: Esm2Config,
     input_ids: jnp.ndarray,
     attention_mask: jnp.ndarray,
+    attn_impl: str = 'auto',
 ) -> jnp.ndarray:
-    """Forward: ``[B, S]`` ids/mask → ``[B, S, H]`` last hidden states."""
+    """Forward: ``[B, S]`` ids/mask → ``[B, S, H]`` last hidden states.
+
+    ``attn_impl`` as in ``bert.apply``: ``'auto'`` uses the Pallas
+    encoder-attention kernel on TPU (ops/encoder_attention.py — replaces
+    the reference's faesm/flash-attn fast path, SURVEY.md section 2.4 N3),
+    ``'xla'`` forces SDPA.
+    """
     dtype = jnp.dtype(cfg.dtype)
     head_dim = cfg.hidden_size // cfg.num_heads
+    seq_len = input_ids.shape[1]
+    from distllm_tpu.ops.encoder_attention import (
+        encoder_attention,
+        resolve_use_pallas,
+    )
+
+    use_pallas = resolve_use_pallas(
+        attn_impl, seq_len, cfg.hidden_size, cfg.num_heads, cfg.dtype
+    )
     cos, sin = common.rope_frequencies(head_dim, input_ids.shape[1], 10000.0)
     cos, sin = jnp.asarray(cos), jnp.asarray(sin)
 
@@ -123,7 +139,17 @@ def apply(
         v = common.split_heads(common.dense(normed, lp['v']['kernel'], lp['v']['bias']), cfg.num_heads)
         q = common.apply_rope(q, cos, sin)
         k = common.apply_rope(k, cos, sin)
-        attn = common.merge_heads(common.sdpa(q, k, v, mask=key_mask))
+        if use_pallas:
+            # merge_heads is a reshape (no transpose); heads stay packed.
+            attn = encoder_attention(
+                common.merge_heads(q),
+                common.merge_heads(k),
+                common.merge_heads(v),
+                attention_mask,
+                cfg.num_heads,
+            )
+        else:
+            attn = common.merge_heads(common.sdpa(q, k, v, mask=key_mask))
         x = x + common.dense(attn, lp['o']['kernel'], lp['o']['bias'])
         normed2 = common.layer_norm(
             x.astype(jnp.float32), lp['mlp_ln']['scale'], lp['mlp_ln']['bias'], cfg.layer_norm_eps

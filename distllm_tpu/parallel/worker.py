@@ -13,6 +13,9 @@ import argparse
 
 
 def main(argv: list[str] | None = None) -> int:
+    from distllm_tpu.utils import apply_platform_env
+
+    apply_platform_env()
     parser = argparse.ArgumentParser(description='distllm-tpu fabric worker')
     parser.add_argument('--coordinator', required=True, help='tcp://host:port')
     parser.add_argument('--heartbeat-interval', type=float, default=5.0)

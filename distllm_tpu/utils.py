@@ -126,6 +126,23 @@ def instantiate(
     return build(config)
 
 
+def apply_platform_env() -> None:
+    """Honor ``JAX_PLATFORMS`` even when a ``sitecustomize`` has already
+    pinned ``jax_platforms`` at interpreter start (the axon TPU-tunnel
+    image does: its pin beats the env var, so ``JAX_PLATFORMS=cpu
+    python -m distllm_tpu...`` would silently grab the TPU). Call first
+    thing in every CLI entrypoint, before any other jax use."""
+    platforms = os.environ.get('JAX_PLATFORMS')
+    if not platforms:
+        return
+    try:
+        import jax
+
+        jax.config.update('jax_platforms', platforms)
+    except Exception:  # jax absent or already initialized — leave as-is
+        pass
+
+
 def batch_data(data: list[T], batch_size: int) -> list[list[T]]:
     """Split ``data`` into consecutive chunks of at most ``batch_size``.
 

@@ -86,6 +86,46 @@ def test_quantize_pytree_policy(np_rng):
     assert 0 < q_bytes < 128 * 128 * 4
 
 
+@pytest.mark.parametrize('mode', ['int8', 'nf4'])
+def test_stacked_qtensor_dequantizes_inside_scan(np_rng, mode):
+    """A stacked [L, in, out] QTensor rides lax.scan over layers: scan
+    slices the codes/scales per layer and dequantize() restores THAT
+    layer's [in, out] weight inside the loop body — the memory-safe
+    serving path (whole-tree dequant OOMed 7B int8, BENCH r3)."""
+    L, n_in, n_out = 3, 32, 48
+    w = np_rng.normal(size=(L, n_in, n_out)).astype(np.float32)
+    qt = (quantize_int8(w) if mode == 'int8'
+          else quantize_nf4(w, block_size=16))
+
+    def body(carry, layer_qt):
+        assert layer_qt.q.ndim == qt.q.ndim - 1  # scan really sliced it
+        return carry, layer_qt.dequantize()
+
+    _, per_layer = jax.lax.scan(body, jnp.zeros(()), qt)
+    assert per_layer.shape == (L, n_in, n_out)
+    for li in range(L):
+        want = (quantize_int8(w[li]) if mode == 'int8'
+                else quantize_nf4(w[li], block_size=16)).dequantize()
+        np.testing.assert_array_equal(
+            np.asarray(per_layer[li]), np.asarray(want)
+        )
+
+
+def test_quantize_pytree_delete_source_streams(np_rng):
+    """delete_source frees each replaced device leaf; kept leaves survive."""
+    params = {
+        'dense': jnp.asarray(np_rng.normal(size=(128, 128)).astype(np.float32)),
+        'norm_scale': jnp.ones((128,), dtype=jnp.float32),
+    }
+    qparams = quantize_pytree(params, mode='int8', min_size=1024,
+                              delete_source=True)
+    assert isinstance(qparams['dense'], QTensor)
+    assert params['dense'].is_deleted()
+    # Pass-through leaves are NOT deleted and remain usable.
+    assert not params['norm_scale'].is_deleted()
+    np.testing.assert_allclose(np.asarray(qparams['norm_scale']), 1.0)
+
+
 def test_nf4_storage_is_under_5_bits_per_weight(np_rng):
     w = np_rng.normal(size=(256, 256)).astype(np.float32)
     qt = quantize_nf4(w, block_size=64)
