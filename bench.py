@@ -35,11 +35,13 @@ import time
 # ----------------------------------------------------------------- stages
 
 
-def _stage_embed() -> dict:
+def _stage_embed(quantization: str | None = None, prefix: str = '') -> dict:
     """Embed pipeline hot loop: bucketed tokenize -> jitted bf16 BERT
     forward -> mean pool -> host copy. PubMedBERT dims
     (microsoft/S-PubMedBert-MS-MARCO = BERT-base), reference production
-    batch 512 (ref README.md:65)."""
+    batch 512 (ref README.md:65). ``quantization='int8'`` measures the
+    weight-only quantized encoder (the TPU stand-in for the reference's
+    NF4 load path, embed/encoders/auto.py:46-56)."""
     import jax
     import numpy as np
 
@@ -51,15 +53,24 @@ def _stage_embed() -> dict:
 
     rng = np.random.default_rng(0)
 
-    cfg = bert.BertConfig(
-        vocab_size=30522,
-        hidden_size=768,
-        num_layers=12,
-        num_heads=12,
-        intermediate_size=3072,
-        max_position_embeddings=512,
-        dtype='bfloat16',
-    )
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        # Smoke-test dims for CPU CI; real runs use PubMedBERT dims.
+        cfg = bert.BertConfig(
+            vocab_size=2048, hidden_size=128, num_layers=2, num_heads=4,
+            intermediate_size=256, max_position_embeddings=512,
+            dtype='float32',
+        )
+    else:
+        cfg = bert.BertConfig(
+            vocab_size=30522,
+            hidden_size=768,
+            num_layers=12,
+            num_heads=12,
+            intermediate_size=3072,
+            max_position_embeddings=512,
+            dtype='bfloat16',
+        )
     params = bert.init(jax.random.PRNGKey(0), cfg)
     tokenizer = WhitespaceTokenizer(vocab_size=cfg.vocab_size, model_max_length=512)
     encoder = JaxEncoder(
@@ -69,14 +80,15 @@ def _stage_embed() -> dict:
         params=jax.device_put(params),
         tokenizer=tokenizer,
         embedding_size=cfg.hidden_size,
+        quantization=quantization,
     )
     pooler = get_pooler({'name': 'mean'})
 
-    batch_size = 512
+    batch_size = 64 if small else 512
     # Chunk-sized texts (~150-250 'words') like jsonl_chunk buffers.
     vocab = [f'tok{i}' for i in range(5000)]
     texts = []
-    for _ in range(2048):
+    for _ in range(128 if small else 2048):
         n = int(rng.integers(120, 260))
         texts.append(' '.join(rng.choice(vocab, size=n)))
 
@@ -89,22 +101,28 @@ def _stage_embed() -> dict:
     assert out.shape == (len(texts), cfg.hidden_size)
     throughput = len(texts) / elapsed
 
-    # Analytic A100 estimate: ~2 * 110e6 params * 256 tokens/seq FLOPs,
-    # 312 TF/s bf16 peak * 50% MFU.
+    # Analytic A100 estimate: 2 * n_params * 256 tokens/seq FLOPs at
+    # 312 TF/s bf16 peak * 50% MFU. n_params comes from the actual config
+    # (110M at PubMedBERT dims) so the small smoke mode reports honest
+    # ratios instead of constants sized for the full model.
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
     tokens_per_seq = 256
-    flops_per_seq = 2 * 110e6 * tokens_per_seq
+    flops_per_seq = 2 * n_params * tokens_per_seq
     a100_estimate = (312e12 * 0.50) / flops_per_seq
 
     peak = _chip_peak_flops(jax.devices()[0])
     mfu = throughput * flops_per_seq / peak if peak else None
-    return {
-        'metric': 'embeddings/sec/chip',
-        'value': round(throughput, 2),
-        'unit': 'emb/s',
-        'vs_baseline': round(throughput / a100_estimate, 3),
-        'mfu': round(mfu, 3) if mfu is not None else None,
-        'device': str(jax.devices()[0].device_kind),
+    out = {
+        f'{prefix}metric': 'embeddings/sec/chip',
+        f'{prefix}value': round(throughput, 2),
+        f'{prefix}unit': 'emb/s',
+        f'{prefix}vs_baseline': round(throughput / a100_estimate, 3),
+        f'{prefix}mfu': round(mfu, 3) if mfu is not None else None,
+        f'{prefix}device': str(jax.devices()[0].device_kind),
     }
+    if quantization:
+        out[f'{prefix}quantization'] = quantization
+    return out
 
 
 def _run_gen(quantization: str | None, prefix: str) -> dict:
@@ -264,6 +282,10 @@ def _stage_gen_q() -> dict:
     return _run_gen('int8', 'gen_int8_')
 
 
+def _stage_embed_q() -> dict:
+    return _stage_embed('int8', 'embed_int8_')
+
+
 def _chip_peak_flops(device) -> float | None:
     """Best-effort bf16 peak FLOP/s for MFU telemetry."""
     kind = getattr(device, 'device_kind', '') or ''
@@ -339,7 +361,9 @@ def _run_stage(stage: str, timeout: int) -> dict:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--stage', choices=['embed', 'gen', 'gen_q'])
+    parser.add_argument(
+        '--stage', choices=['embed', 'embed_q', 'gen', 'gen_q']
+    )
     args = parser.parse_args()
 
     # The environment's sitecustomize pins jax_platforms='axon,cpu' at
@@ -365,6 +389,9 @@ def main() -> None:
     if args.stage == 'embed':
         print(json.dumps(_stage_embed()))
         return
+    if args.stage == 'embed_q':
+        print(json.dumps(_stage_embed_q()))
+        return
     if args.stage == 'gen':
         print(json.dumps(_stage_gen()))
         return
@@ -385,6 +412,7 @@ def main() -> None:
         return
 
     result.update(_run_stage('embed', timeout=1200))
+    result.update(_run_stage('embed_q', timeout=1200))
     result.update(_run_stage('gen', timeout=2700))
     result.update(_run_stage('gen_q', timeout=2700))
     print(json.dumps(result))

@@ -39,10 +39,11 @@ def compute_embeddings(
 
     Dispatch is asynchronous: each batch's forward+pool is enqueued and the
     pooled ``[B, H]`` device arrays are collected without blocking, so host
-    tokenization of batch *i+1* overlaps device compute of batch *i*. Results
-    flush to the host buffer every ``flush_every`` batches (bounds retained
-    pooled outputs at ``flush_every * B * H`` floats — ~100 MB at B=512,
-    H=768; lower ``flush_every`` for large-H models on small-HBM chips).
+    tokenization of batch *i+1* overlaps device compute of batch *i*. Every
+    ``flush_every`` batches the pooled rows are concatenated ON DEVICE into
+    one array whose host copy starts asynchronously (one device→host round
+    trip per group rather than per batch); all groups are gathered into the
+    host buffer once the loop ends.
     """
     n = len(texts)
     out = np.empty((n, encoder.embedding_size), dtype=np.float32)
@@ -50,6 +51,14 @@ def compute_embeddings(
         return out
     order = sorted(range(n), key=lambda i: len(texts[i].split()))
     pending: list[tuple[list[int], jnp.ndarray]] = []
+    # (indices, concatenated device array) per flush group, fetched at the
+    # end. Pooled rows are tiny ([N, H] fp32), so whole-corpus residency on
+    # device is trivial next to the model — what matters is ROUND TRIPS: on
+    # a remote-tunneled chip a device→host fetch costs ~70-90 ms latency
+    # regardless of size (measured, scripts/probe_embed2.py), so fetching
+    # per batch serializes ~90 ms × batches into the loop, while one
+    # device-side concat per flush group + one async copy amortizes it.
+    groups: list[tuple[list[int], jnp.ndarray]] = []
     # Fused encode+pool (one dispatch/batch) when the encoder supports it;
     # composed per-stage dispatches otherwise (e.g. FakeEncoder).
     fused = (
@@ -58,9 +67,16 @@ def compute_embeddings(
         else None
     )
 
-    def flush() -> None:
-        for idx, dev in pending:
-            out[idx] = np.asarray(dev, dtype=np.float32)[: len(idx)]
+    def seal_group() -> None:
+        if not pending:
+            return
+        idx_all = [i for idx, _ in pending for i in idx]
+        rows = [dev[: len(idx)] for idx, dev in pending]
+        group = jnp.concatenate(rows, axis=0) if len(rows) > 1 else rows[0]
+        copy_async = getattr(group, 'copy_to_host_async', None)
+        if copy_async is not None:
+            copy_async()  # overlaps later groups' compute
+        groups.append((idx_all, group))
         pending.clear()
 
     for lo in range(0, n, batch_size):
@@ -78,15 +94,12 @@ def compute_embeddings(
                     jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
                 )
             pooled = pooled.astype(jnp.float32)
-        # Start the device→host copy now so it overlaps later batches'
-        # compute; flush()'s np.asarray then finds the bytes already local.
-        copy_async = getattr(pooled, 'copy_to_host_async', None)
-        if copy_async is not None:
-            copy_async()
         pending.append((idx, pooled))
         if len(pending) >= flush_every:
-            flush()
-    flush()
+            seal_group()
+    seal_group()
+    for idx_all, group in groups:
+        out[idx_all] = np.asarray(group, dtype=np.float32)
     return out
 
 

@@ -123,12 +123,24 @@ def apply(
     cfg: EsmcConfig,
     input_ids: jnp.ndarray,  # [B, S]
     attention_mask: jnp.ndarray,  # [B, S]
+    attn_impl: str = 'auto',
 ) -> jnp.ndarray:
     """Forward → last hidden states ``[B, S, H]`` (after the final norm —
-    exactly what the reference's ``encode`` returns as embeddings)."""
+    exactly what the reference's ``encode`` returns as embeddings).
+
+    ``attn_impl`` as in ``bert.apply`` (shared policy,
+    ops/encoder_attention.py resolve_use_pallas)."""
+    from distllm_tpu.ops.encoder_attention import (
+        encoder_attention,
+        resolve_use_pallas,
+    )
+
     dtype = jnp.dtype(cfg.dtype)
     b, s = input_ids.shape
     eps = cfg.layer_norm_eps
+    use_pallas = resolve_use_pallas(
+        attn_impl, s, cfg.hidden_size, cfg.num_heads, cfg.dtype
+    )
     cos, sin = common.rope_frequencies(cfg.head_size, s, cfg.rope_theta)
     cos, sin = jnp.asarray(cos), jnp.asarray(sin)
     inv_scale = jnp.asarray(1.0 / cfg.residue_scale, dtype)
@@ -158,10 +170,18 @@ def apply(
         v = common.split_heads(v, cfg.num_heads)
         q = common.apply_rope(q, cos, sin)
         k = common.apply_rope(k, cos, sin)
-        attn = common.sdpa(q, k, v, mask=mask)
-        x = x + common.dense(
-            common.merge_heads(attn), lp['out']['kernel']
-        ) * inv_scale
+        if use_pallas:
+            # merge_heads is a reshape (no transpose); heads stay packed.
+            attn = encoder_attention(
+                common.merge_heads(q),
+                common.merge_heads(k),
+                common.merge_heads(v),
+                attention_mask,
+                cfg.num_heads,
+            )
+        else:
+            attn = common.merge_heads(common.sdpa(q, k, v, mask=mask))
+        x = x + common.dense(attn, lp['out']['kernel']) * inv_scale
         normed2 = ln(x, lp['ffn_ln'])
         gate_up = common.dense(normed2, lp['ffn_in']['kernel'])
         gate, up = jnp.split(gate_up, 2, axis=-1)

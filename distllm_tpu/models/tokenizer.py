@@ -30,10 +30,12 @@ def bucket_ladder(
     """Ladder of sequence buckets up to ``max_length``.
 
     ``scheme='fine'`` (embed hot loop): geometric (x2) up to 64, then linear
-    steps of 32 (to 256), 64 (to 512), and 128 beyond. Finer rungs than a
+    steps of 32 (to 384), 64 (to 512), and 128 beyond. Finer rungs than a
     pure x2 ladder cut padding waste from ~35% to ~10% on chunk-sized text
     (120-260 tokens); with length-sorted batching only a handful of rungs are
-    ever touched, so the compile count stays small.
+    ever touched, so the compile count stays small. (The 256-384 range used
+    to step by 64: the 320 rung alone cost ~23% padding on 260-token chunk
+    tails — measured, BENCH r2 embed breakdown.)
 
     ``scheme='pow2'`` (serving prefill): pure doubling — at most
     ``log2(max_length)`` compiled prefill programs, since at serving time
@@ -50,7 +52,7 @@ def bucket_ladder(
         buckets.append(b)
         if scheme == 'pow2' or b < 64:
             b *= 2
-        elif b < 256:
+        elif b < 384:
             b += 32
         elif b < 512:
             b += 64

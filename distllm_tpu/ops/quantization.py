@@ -206,12 +206,15 @@ def _should_quantize(path: tuple, leaf: Any, min_size: int) -> bool:
     ):
         return False
     keys = '/'.join(str(getattr(k, 'key', k)) for k in path).lower()
-    # Embedding tables, norm scales, biases, and the output head stay full
-    # precision (bnb does the same: only nn.Linear *weights* are quantized,
-    # and lm_head is exempted via llm_int8_skip_modules). Stacked biases are
-    # 2-D [L, out], hence the name gate rather than an ndim gate.
+    # Embedding tables, norm scales, biases, the output head, and MoE
+    # router kernels stay full precision (bnb quantizes only nn.Linear
+    # weights and exempts lm_head via llm_int8_skip_modules; routers are
+    # tiny [H, E] and routing is precision-sensitive — and they feed
+    # moe_mlp's raw einsums, which expect float arrays). Stacked biases
+    # are 2-D [L, out], hence the name gate rather than an ndim gate.
     return not any(
-        tag in keys for tag in ('embed', 'norm', 'ln', 'bias', 'head')
+        tag in keys
+        for tag in ('embed', 'norm', 'ln', 'bias', 'head', 'router')
     )
 
 

@@ -76,12 +76,17 @@ def test_quantize_pytree_policy(np_rng):
             'tiny': np_rng.normal(size=(4, 4)).astype(np.float32),
         },
     }
+    params['layer0']['router'] = {
+        'kernel': np_rng.normal(size=(128, 128)).astype(np.float32)
+    }
     qparams = quantize_pytree(params, mode='int8', min_size=1024)
     assert isinstance(qparams['layer0']['dense'], QTensor)
-    # Embedding tables, norms, and small leaves stay float.
+    # Embedding tables, norms, small leaves, and MoE routers stay float
+    # (routers feed moe_mlp's raw einsums and are precision-sensitive).
     assert isinstance(qparams['embeddings']['word'], np.ndarray)
     assert isinstance(qparams['layer0']['norm_scale'], np.ndarray)
     assert isinstance(qparams['layer0']['tiny'], np.ndarray)
+    assert isinstance(qparams['layer0']['router']['kernel'], np.ndarray)
     q_bytes, _ = quantized_nbytes(qparams)
     assert 0 < q_bytes < 128 * 128 * 4
 

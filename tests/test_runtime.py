@@ -52,7 +52,8 @@ def test_shard_pytree_matmul():
 
 def test_bucket_ladder():
     assert bucket_ladder(512, 16) == [
-        16, 32, 64, 96, 128, 160, 192, 224, 256, 320, 384, 448, 512,
+        16, 32, 64, 96, 128, 160, 192, 224, 256, 288, 320, 352, 384,
+        448, 512,
     ]
     assert bucket_ladder(100, 16) == [16, 32, 64, 96, 100]
     assert bucket_ladder(1024, 16)[-3:] == [768, 896, 1024]
