@@ -7,6 +7,10 @@ from __future__ import annotations
 import sys as _sys, pathlib as _pl
 _sys.path.insert(0, str(_pl.Path(__file__).resolve().parent.parent))
 
+from distllm_tpu.utils import apply_platform_env
+
+apply_platform_env()
+
 import time
 
 import jax

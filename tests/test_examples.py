@@ -159,3 +159,55 @@ def test_launch_pod_script_exists():
     script = (EXAMPLES / 'pod' / 'launch_pod.sh').read_text()
     assert 'distllm_tpu.parallel.worker' in script
     assert '--coordinator' in script
+
+
+def test_protein_search_example_runs(tmp_path):
+    """FASTA corpus -> fake-encoder embeddings -> exact search, end to end
+    through the example app (the reference ships examples/protein_search.py)."""
+    import subprocess
+    import sys
+
+    from distllm_tpu.distributed_embedding import Config, run_embedding
+
+    (tmp_path / 'inputs').mkdir()
+    seqs = ''.join(
+        f'>prot{i}\n' + 'ACDEFGHIKLMNPQRSTVWY'[: 5 + i % 12] * 3 + '\n'
+        for i in range(8)
+    )
+    (tmp_path / 'inputs' / 'corpus.fasta').write_text(seqs)
+    cfg = Config(
+        input_dir=tmp_path / 'inputs',
+        output_dir=tmp_path / 'emb',
+        glob_patterns=['*.fasta'],
+        dataset_config={'name': 'fasta', 'batch_size': 4},
+        encoder_config={'name': 'fake', 'embedding_size': 16},
+        pooler_config={'name': 'mean'},
+        embedder_config={'name': 'full_sequence'},
+        writer_config={'name': 'huggingface'},
+        compute_config={'name': 'local'},
+    )
+    assert run_embedding(cfg) == 0
+    shard = next((tmp_path / 'emb' / 'embeddings').iterdir())
+    queries = tmp_path / 'queries.fasta'
+    queries.write_text('>q0\nACDEF\n>q1\nACDEFGHIK\n')
+    out = tmp_path / 'hits.jsonl'
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / 'protein_search.py'),
+         '--dataset_dir', str(shard), '--fasta', str(queries),
+         '--encoder', 'fake', '--top_k', '3', '--output', str(out)],
+        capture_output=True, text=True,
+        env={
+            **__import__('os').environ,
+            'JAX_PLATFORMS': 'cpu',
+            # The example has no sys.path bootstrap; make the test work on
+            # uninstalled checkouts too.
+            'PYTHONPATH': str(EXAMPLES.parent),
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [json.loads(x) for x in out.read_text().splitlines()]
+    assert len(lines) == 2
+    # score_threshold=0.0 drops negative-similarity hits (reference
+    # semantics), so up to top_k survive.
+    assert all(1 <= len(line['hits']) <= 3 for line in lines)
+    assert all('tag' in h and 'score' in h for h in lines[0]['hits'])
