@@ -36,6 +36,7 @@ from enum import Enum
 import jax
 import jax.numpy as jnp
 import numpy as np
+from pydantic import field_validator
 
 from distllm_tpu.generate.engine.kv_cache import PagedKVCache
 from distllm_tpu.generate.engine.scheduler import (
@@ -109,6 +110,22 @@ class EngineConfig(BaseConfig):
     # Tokens generated per decode dispatch (the fused lax.scan window).
     # 1 restores per-token dispatch; >1 amortizes dispatch+sync latency.
     decode_steps: int = 8
+    # Sampling considers only the top-K logits per step (vLLM's top_k
+    # semantic, applied before top-p). Avoids a full-vocab sort inside the
+    # decode scan — XLA's TPU sort over 32k is a multi-pass bitonic
+    # network paid every step. Probabilities keep the full-vocab
+    # normalizer, so top-p/min-p are exact whenever the cutoff falls
+    # inside the window. Default 0 = exact full-vocab semantics (reference
+    # parity: vLLM's top_k is off by default); serving deployments that
+    # want the fast path set 64 explicitly (bench.py does).
+    sampling_top_window: int = 0
+
+    @field_validator('sampling_top_window')
+    @classmethod
+    def _non_negative_window(cls, v: int) -> int:
+        if v < 0:
+            raise ValueError('sampling_top_window must be >= 0')
+        return v
     # Decode windows in flight during generate_ids (2 hides the
     # host<->device round trip behind the next window's compute).
     pipeline_depth: int = 2
@@ -240,6 +257,7 @@ class LLMEngine:
                 params, model, ids, pos, k, v, bt, ctx, steps_left,
                 temp, top_p, min_p, key, num_steps=num_steps,
                 attn_backend=attn_backend, max_table_positions=max_tables,
+                sampling_top_window=cfg.sampling_top_window,
             )
 
         self._decode_window = jax.jit(window_fn, donate_argnums=(4, 5))
@@ -277,7 +295,11 @@ class LLMEngine:
         self._write_prefill = jax.jit(
             _write_prefill_all_layers, donate_argnums=(0, 1)
         )
-        self._sample = jax.jit(sample_tokens)
+        self._sample = jax.jit(
+            lambda lg, ky, t, tp, mp: sample_tokens(
+                lg, ky, t, tp, mp, top_window=cfg.sampling_top_window
+            )
+        )
         # Tokens dispatched on device but not yet fetched, per request —
         # the pipelined path's lag bookkeeping.
         self._unacked: dict[int, int] = {}

@@ -399,6 +399,7 @@ def decode_loop(
     num_steps: int,
     attn_backend: str = 'xla',
     max_table_positions: int | None = None,
+    sampling_top_window: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """``num_steps`` fused decode+sample steps in ONE dispatch.
 
@@ -436,7 +437,10 @@ def decode_loop(
             params, cfg, ids, pos, k_cache, v_cache, bt_eff, ctx,
             cos, sin, attn_backend,
         )
-        token = sample_tokens(logits_, step_key, temperature, top_p, min_p)
+        token = sample_tokens(
+            logits_, step_key, temperature, top_p, min_p,
+            top_window=sampling_top_window,
+        )
         ids = jnp.where(live, token, ids)
         pos = jnp.where(live, pos + 1, pos)
         ctx = jnp.where(live, ctx + 1, ctx)

@@ -7,10 +7,21 @@ fp32 logits; each sequence carries its own parameters so one decode batch can
 mix sampling configs (continuous batching requirement).
 
 This runs INSIDE the engine's fused decode scan (one sample per decode
-step), so it is written for the TPU hot path: a single descending sort
-serves the top-p cutoff, and min-p is applied as a pure log-space
-comparison (``prob >= min_p * max_prob  <=>  logit >= max_logit +
-log(min_p)``) — no softmax materialization, no second sort.
+step), so it is written for the TPU hot path: ONE implementation over the
+``top_window`` largest logits (``jax.lax.top_k``), with ``top_window = V``
+recovering the exact full-vocabulary semantics (top_k(V) is a descending
+sort). Probabilities always use the full-vocab logsumexp normalizer, so
+top-p prefixes and min-p thresholds are exact whenever the top-p cutoff
+falls inside the window; min-p is a pure log-space comparison
+(``prob >= min_p * max_prob  <=>  logit >= max_logit + log(min_p)``) — no
+softmax materialization.
+
+Why a window at all: XLA's TPU sort over V=32k is a multi-pass bitonic
+network, paid once per decode step inside a 16-step window scan. A
+``top_window`` of 64 (the engine's recommended serving setting; vLLM's
+``top_k`` semantic, applied before top-p) replaces it with one
+``lax.top_k`` pass. The library default is 0 (= exact) to preserve
+reference parity for pure-temperature sampling.
 """
 
 from __future__ import annotations
@@ -19,41 +30,61 @@ import jax
 import jax.numpy as jnp
 
 
-def _top_p_from_sorted(
-    logits: jnp.ndarray, sorted_desc: jnp.ndarray, top_p: jnp.ndarray
-) -> jnp.ndarray:
-    sorted_probs = jax.nn.softmax(sorted_desc, axis=-1)
-    cumulative = jnp.cumsum(sorted_probs, axis=-1)
-    # Keep the smallest prefix with cumulative >= top_p (always >= 1 token).
-    cutoff_idx = jnp.sum(cumulative < top_p[:, None], axis=-1)
-    cutoff_logit = jnp.take_along_axis(
-        sorted_desc, cutoff_idx[:, None], axis=-1
-    )
-    keep = logits >= cutoff_logit
-    return jnp.where(keep, logits, -jnp.inf)
-
-
 def sample_tokens(
     logits: jnp.ndarray,  # [B, V] fp32
     key: jax.Array,
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B] (1.0 disables)
     min_p: jnp.ndarray,  # [B] (0.0 disables)
+    top_window: int = 0,
 ) -> jnp.ndarray:
-    """Per-sequence sampling; temperature == 0 rows are greedy."""
-    logits = logits.astype(jnp.float32)
+    """Per-sequence sampling; temperature == 0 rows are greedy.
 
+    ``top_window > 0`` caps the kept set at that many tokens (see module
+    docstring); ``0`` or ``>= V`` is exact.
+    """
+    vocab = logits.shape[-1]
+    k = vocab if top_window <= 0 else min(top_window, vocab)
+
+    logits = logits.astype(jnp.float32)
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_temp[:, None]
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    greedy = jnp.argmax(logits, axis=-1)
 
-    filtered = _top_p_from_sorted(scaled, sorted_desc, top_p)
-    # min-p in log space: prob >= min_p * max_prob is equivalent to
-    # logit >= max_logit + log(min_p); log(0) = -inf disables the filter.
-    max_logit = sorted_desc[:, :1]
-    min_p_threshold = max_logit + jnp.log(jnp.maximum(min_p, 0.0))[:, None]
-    filtered = jnp.where(scaled >= min_p_threshold, filtered, -jnp.inf)
+    top_vals, top_idx = jax.lax.top_k(scaled, k)  # descending
+    # Exact probabilities: normalize against the whole vocabulary.
+    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    probs = jnp.exp(top_vals - lse)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # Keep the smallest prefix with cumulative >= top_p (always >= 1 token).
+    cutoff_idx = jnp.minimum(
+        jnp.sum(cumulative < top_p[:, None], axis=-1), k - 1
+    )
+    cutoff_logit = jnp.take_along_axis(top_vals, cutoff_idx[:, None], axis=-1)
+    filtered = jnp.where(top_vals >= cutoff_logit, top_vals, -jnp.inf)
+    # min-p in log space; log(0) = -inf disables the filter.
+    min_p_threshold = top_vals[:, :1] + jnp.log(
+        jnp.maximum(min_p, 0.0)
+    )[:, None]
+    filtered = jnp.where(top_vals >= min_p_threshold, filtered, -jnp.inf)
 
-    sampled = jax.random.categorical(key, filtered, axis=-1)
-    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+    choice = jax.random.categorical(key, filtered, axis=-1)
+    sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature > 0, sampled, top_idx[:, 0]).astype(
+        jnp.int32
+    )
+
+
+def sample_tokens_windowed(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    min_p: jnp.ndarray,
+    top_window: int,
+) -> jnp.ndarray:
+    """Alias for :func:`sample_tokens` with an explicit window (kept for
+    call sites that always window)."""
+    return sample_tokens(
+        logits, key, temperature, top_p, min_p,
+        top_window=max(1, top_window),
+    )

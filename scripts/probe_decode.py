@@ -9,6 +9,8 @@ between the measured window time and the ~283 ms weight-streaming floor
 - window length: decode_steps 1 / 8 / 16 / 32 (per-token cost should fall
   as dispatch overhead amortizes; if it doesn't, the per-step compute is
   the problem, not dispatch)
+- sampler: top-64 window vs exact full-vocab sort (the 32k bitonic sort
+  per step is a prime suspect)
 """
 
 from __future__ import annotations
@@ -70,13 +72,16 @@ def main() -> None:
 
     weight_gb = 2 * n_params / 1e9
     print(f'batch={batch} ctx={ctx} weights={weight_gb:.1f} GB')
-    for backend in backends:
-        for num_steps in steps_list:
+    cases = [(be, ns, 64) for be in backends for ns in steps_list]
+    # Sampler ablation: exact 32k sort at the serving window length.
+    cases.append((backends[0], steps_list[-1], 0))
+    for backend, num_steps, top_window in cases:
             fn = jax.jit(
                 lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky, ns=num_steps,
-                       be=backend: mistral.decode_loop(
+                       be=backend, tw=top_window: mistral.decode_loop(
                     p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
                     num_steps=ns, attn_backend=be, max_table_positions=512,
+                    sampling_top_window=tw,
                 ),
                 donate_argnums=(4, 5),
             )
@@ -105,14 +110,15 @@ def main() -> None:
                     np.asarray(t)
                 best = (time.perf_counter() - t0) / n_reps
                 floor = num_steps * 2 * n_params / 819e9
-                print(f'{backend:6s} steps={num_steps:2d}: {best*1e3:7.1f} ms'
+                print(f'{backend:6s} steps={num_steps:2d} tw={top_window:2d}:'
+                      f' {best*1e3:7.1f} ms'
                       f' ({best/num_steps*1e3:6.2f} ms/step,'
                       f' {batch*num_steps/best:7.0f} tok/s,'
                       f' floor {floor*1e3:5.0f} ms, x{best/floor:4.1f})',
                       flush=True)
             except Exception as exc:
-                print(f'{backend:6s} steps={num_steps:2d}: FAILED '
-                      f'{repr(exc)[:200]}', flush=True)
+                print(f'{backend:6s} steps={num_steps:2d} tw={top_window:2d}:'
+                      f' FAILED {repr(exc)[:200]}', flush=True)
 
 
 if __name__ == '__main__':
