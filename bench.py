@@ -310,12 +310,15 @@ def _chip_peak_flops(device) -> float | None:
 # ------------------------------------------------------------ orchestrator
 
 
-def _probe_backend(attempts: int = 3, timeout: int = 150) -> str | None:
+def _probe_backend(attempts: int = 6, timeout: int = 150) -> str | None:
     """Confirm the TPU backend initializes, in a killable subprocess.
 
     Round 1's bench died with 'backend UNAVAILABLE' after a wedged earlier
     process; a hung init here is killed by the timeout and retried rather
-    than hanging the bench itself. Returns None on success, else the error.
+    than hanging the bench itself. Round 3 saw a pool-side wedged claim
+    hang clients for hours — hence the longer retry ladder (~15 min worst
+    case; a transient wedge is worth waiting out, the metrics are the
+    round's record). Returns None on success, else the error.
     """
     err = 'unknown'
     # Mirror the stage subprocesses: re-apply JAX_PLATFORMS through the
@@ -338,7 +341,7 @@ def _probe_backend(attempts: int = 3, timeout: int = 150) -> str | None:
         except subprocess.TimeoutExpired:
             err = f'backend init timed out after {timeout}s'
         if attempt < attempts - 1:
-            time.sleep(5 * (attempt + 1))
+            time.sleep(20 * (attempt + 1))
     return err
 
 
