@@ -16,7 +16,7 @@ from typing import Literal
 from pydantic import Field
 
 from distllm_tpu.embed.encoders.base import JaxEncoder
-from distllm_tpu.models import bert, esm2, mistral, mixtral
+from distllm_tpu.models import bert, esm2, mistral, mixtral, modernbert
 from distllm_tpu.models.loader import read_checkpoint, read_hf_config
 from distllm_tpu.models.tokenizer import HFTokenizer
 from distllm_tpu.utils import BaseConfig
@@ -27,6 +27,7 @@ _FAMILIES = {
     'llama': (mistral.MistralConfig, mistral),
     'mixtral': (mixtral.MixtralConfig, mixtral),
     'esm': (esm2.Esm2Config, esm2),
+    'modernbert': (modernbert.ModernBertConfig, modernbert),
 }
 
 

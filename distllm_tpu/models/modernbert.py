@@ -1,0 +1,330 @@
+"""ModernBERT encoder (answerdotai/ModernBERT class models) in pure JAX.
+
+The reference embeds ModernBERT checkpoints through ``transformers.AutoModel``
+(``distllm/embed/encoders/auto.py:119-138``; its README pairs the encoder with
+nomic/ModernBERT embeddings). TPU-native redesign in the house style: one
+``lax.scan`` over stacked layer params compiles a single layer body for all
+22 layers, with the architecture's per-layer heterogeneity expressed as
+traced *flag vectors* instead of Python branching (XLA-friendly):
+
+- layer 0's attention pre-norm is Identity (HF ``ModernBertEncoderLayer``)
+  → ``attn_norm_flag[L]`` selects LN(x) vs x;
+- every ``global_attn_every_n_layers``-th layer attends globally, the rest
+  within a ``local_attention``-token sliding window (|i-j| <= window // 2)
+  → ``global_flag[L]`` selects between the two precomputed masks AND
+  between the two RoPE tables (global vs local theta).
+
+Numerics follow HF ``ModernBertModel``: pre-LN residuals, bias-free GeGLU
+MLP (``act(input) * gate``), RoPE (rotate-half layout), LayerNorm with
+optional bias, final norm on the output. Verified against ``transformers``
+in tests/test_modernbert.py.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distllm_tpu.models import common
+from distllm_tpu.utils import BaseConfig
+
+
+class ModernBertConfig(BaseConfig):
+    name: Literal['modernbert'] = 'modernbert'
+    vocab_size: int = 50368
+    hidden_size: int = 768
+    num_layers: int = 22
+    num_heads: int = 12
+    intermediate_size: int = 1152
+    norm_eps: float = 1e-5
+    norm_bias: bool = False
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    global_attn_every_n_layers: int = 3
+    local_attention: int = 128
+    global_rope_theta: float = 160000.0
+    local_rope_theta: float = 10000.0
+    hidden_act: str = 'gelu'
+    max_position_embeddings: int = 8192
+    dtype: str = 'bfloat16'
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf_config(cls, hf: dict) -> 'ModernBertConfig':
+        return cls(
+            vocab_size=hf['vocab_size'],
+            hidden_size=hf['hidden_size'],
+            num_layers=hf['num_hidden_layers'],
+            num_heads=hf['num_attention_heads'],
+            intermediate_size=hf['intermediate_size'],
+            norm_eps=hf.get('norm_eps', 1e-5),
+            norm_bias=hf.get('norm_bias', False),
+            attention_bias=hf.get('attention_bias', False),
+            mlp_bias=hf.get('mlp_bias', False),
+            global_attn_every_n_layers=hf.get('global_attn_every_n_layers', 3),
+            local_attention=hf.get('local_attention', 128),
+            global_rope_theta=hf.get('global_rope_theta', 160000.0),
+            local_rope_theta=hf.get('local_rope_theta', 10000.0),
+            hidden_act=hf.get('hidden_activation', 'gelu'),
+            max_position_embeddings=hf.get('max_position_embeddings', 8192),
+        )
+
+
+def _ln(size):
+    return {
+        'scale': np.ones((size,), np.float32),
+        'bias': np.zeros((size,), np.float32),
+    }
+
+
+def init(rng: jax.Array, cfg: ModernBertConfig) -> dict:
+    """Random-init params (tests/benchmarks); layout matches params_from_hf."""
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    scale = 0.02
+
+    def normal(key, shape):
+        return np.asarray(jax.random.normal(key, shape) * scale, np.float32)
+
+    keys = jax.random.split(rng, 4)
+    layers = []
+    for li in range(cfg.num_layers):
+        ks = jax.random.split(jax.random.fold_in(keys[0], li), 7)
+
+        def lin(key, shape, biased):
+            out = {'kernel': normal(key, shape)}
+            if biased:
+                out['bias'] = np.zeros((shape[-1],), np.float32)
+            return out
+
+        layers.append(
+            {
+                'attn_norm': _ln(h),
+                'q': lin(ks[0], (h, h), cfg.attention_bias),
+                'k': lin(ks[1], (h, h), cfg.attention_bias),
+                'v': lin(ks[2], (h, h), cfg.attention_bias),
+                'o': lin(ks[3], (h, h), cfg.attention_bias),
+                'mlp_norm': _ln(h),
+                'wi_in': lin(ks[4], (h, inter), cfg.mlp_bias),
+                'wi_gate': lin(ks[5], (h, inter), cfg.mlp_bias),
+                'wo': lin(ks[6], (inter, h), cfg.mlp_bias),
+            }
+        )
+    return {
+        'embed': normal(keys[1], (cfg.vocab_size, h)),
+        'embed_norm': _ln(h),
+        'final_norm': _ln(h),
+        'layers': common.stack_layers(layers),
+        'attn_norm_flag': _attn_norm_flags(cfg),
+        'global_flag': _global_flags(cfg),
+    }
+
+
+def _attn_norm_flags(cfg: ModernBertConfig) -> np.ndarray:
+    """1.0 where the attention pre-norm applies (HF: Identity on layer 0)."""
+    flags = np.ones((cfg.num_layers, 1), np.float32)
+    flags[0] = 0.0
+    return flags
+
+
+def _global_flags(cfg: ModernBertConfig) -> np.ndarray:
+    """1.0 for global-attention layers (every n-th, counting from 0)."""
+    return np.asarray(
+        [
+            [1.0 if li % cfg.global_attn_every_n_layers == 0 else 0.0]
+            for li in range(cfg.num_layers)
+        ],
+        np.float32,
+    )
+
+
+def apply(
+    params: dict,
+    cfg: ModernBertConfig,
+    input_ids: jnp.ndarray,  # [B, S]
+    attention_mask: jnp.ndarray,  # [B, S]
+) -> jnp.ndarray:
+    """Forward: ``[B, S]`` ids/mask → ``[B, S, H]`` final hidden states."""
+    dtype = jnp.dtype(cfg.dtype)
+    act = common.ACTIVATIONS[cfg.hidden_act]
+    seq = input_ids.shape[1]
+    eps = cfg.norm_eps
+
+    def maybe_bias(p):
+        return p.get('bias') if isinstance(p, dict) else None
+
+    def ln(h, p):
+        return common.layer_norm(
+            h.astype(jnp.float32), p['scale'], p['bias'], eps
+        ).astype(dtype)
+
+    cos_g, sin_g = common.rope_frequencies(
+        cfg.head_dim, seq, cfg.global_rope_theta
+    )
+    cos_l, sin_l = common.rope_frequencies(
+        cfg.head_dim, seq, cfg.local_rope_theta
+    )
+    cos_g, sin_g = jnp.asarray(cos_g), jnp.asarray(sin_g)
+    cos_l, sin_l = jnp.asarray(cos_l), jnp.asarray(sin_l)
+
+    # [B, 1, S, S] masks: padding-only (global) and padding+window (local).
+    key_valid = attention_mask.astype(bool)[:, None, None, :]
+    distance = jnp.abs(
+        jnp.arange(seq)[:, None] - jnp.arange(seq)[None, :]
+    )
+    window = (distance <= cfg.local_attention // 2)[None, None]
+    local_valid = key_valid & window
+
+    x = ln(jnp.asarray(params['embed'])[input_ids], params['embed_norm'])
+
+    def layer(x, per_layer):
+        lp, attn_norm_flag, global_flag = per_layer
+        normed = ln(x, lp['attn_norm'])
+        # Layer 0: HF uses Identity for the attention pre-norm.
+        normed = jnp.where(attn_norm_flag > 0, normed, x)
+        # Q/K/V stored as separate column-sharded kernels (HF's fused Wqkv
+        # is split at load time): under TP, splitting a fused [B, S, 3H]
+        # activation at non-shard-aligned offsets would force per-layer
+        # resharding collectives.
+        q = common.split_heads(
+            common.dense(normed, lp['q']['kernel'], maybe_bias(lp['q'])),
+            cfg.num_heads,
+        )
+        k = common.split_heads(
+            common.dense(normed, lp['k']['kernel'], maybe_bias(lp['k'])),
+            cfg.num_heads,
+        )
+        v = common.split_heads(
+            common.dense(normed, lp['v']['kernel'], maybe_bias(lp['v'])),
+            cfg.num_heads,
+        )
+        is_global = global_flag > 0
+        cos = jnp.where(is_global, cos_g, cos_l)
+        sin = jnp.where(is_global, sin_g, sin_l)
+        q = common.apply_rope(q, cos, sin)
+        k = common.apply_rope(k, cos, sin)
+        mask = jnp.where(is_global, key_valid, local_valid)
+        attn = common.merge_heads(common.sdpa(q, k, v, mask=mask))
+        x = x + common.dense(attn, lp['o']['kernel'], maybe_bias(lp['o']))
+        normed2 = ln(x, lp['mlp_norm'])
+        gate_in = common.dense(
+            normed2, lp['wi_in']['kernel'], maybe_bias(lp['wi_in'])
+        )
+        gate = common.dense(
+            normed2, lp['wi_gate']['kernel'], maybe_bias(lp['wi_gate'])
+        )
+        mlp = common.dense(
+            act(gate_in) * gate, lp['wo']['kernel'], maybe_bias(lp['wo'])
+        )
+        return x + mlp, None
+
+    x, _ = jax.lax.scan(
+        layer,
+        x,
+        (
+            params['layers'],
+            jnp.asarray(params['attn_norm_flag']),
+            jnp.asarray(params['global_flag']),
+        ),
+    )
+    return common.layer_norm(
+        x.astype(jnp.float32),
+        params['final_norm']['scale'],
+        params['final_norm']['bias'],
+        eps,
+    )
+
+
+def param_specs(cfg: ModernBertConfig) -> dict:
+    """Megatron-style TP over the ``model`` axis (QKV/Wi column, O/Wo row)."""
+    ln = {'scale': P(None), 'bias': P(None)}
+    return {
+        'embed': P(None, None),
+        'embed_norm': dict(ln),
+        'final_norm': dict(ln),
+        'attn_norm_flag': P(None, None),
+        'global_flag': P(None, None),
+        'layers': {
+            'attn_norm': dict(ln),
+            'q': {'kernel': P(None, None, 'model')},
+            'k': {'kernel': P(None, None, 'model')},
+            'v': {'kernel': P(None, None, 'model')},
+            'o': {'kernel': P(None, 'model', None)},
+            'mlp_norm': dict(ln),
+            'wi_in': {'kernel': P(None, None, 'model')},
+            'wi_gate': {'kernel': P(None, None, 'model')},
+            'wo': {'kernel': P(None, 'model', None)},
+        },
+    }
+
+
+def params_from_hf(state: dict[str, np.ndarray], cfg: ModernBertConfig) -> dict:
+    """Convert an HF ``ModernBertModel`` state dict to this module's tree.
+
+    Accepts both the bare-model layout (``layers.0...``) and the
+    task-model layout (``model.layers.0...``). Layer 0 ships no
+    ``attn_norm`` weights (Identity) — identity LN params are substituted
+    and the flag vector masks the norm out.
+    """
+    sd = {k.removeprefix('model.'): v for k, v in state.items()}
+
+    def lin(prefix):
+        out = {'kernel': np.ascontiguousarray(sd[f'{prefix}.weight'].T)}
+        if f'{prefix}.bias' in sd:
+            out['bias'] = sd[f'{prefix}.bias']
+        return out
+
+    def ln(prefix, size):
+        if f'{prefix}.weight' not in sd:  # layer 0 Identity attn_norm
+            return _ln(size)
+        return {
+            'scale': sd[f'{prefix}.weight'],
+            'bias': sd.get(
+                f'{prefix}.bias',
+                np.zeros_like(sd[f'{prefix}.weight']),
+            ),
+        }
+
+    def split_cols(linear: dict, n: int) -> list[dict]:
+        """Split a fused [in, n*out] linear into n separate kernels (TP
+        wants each column-sharded on its own)."""
+        kernels = np.split(linear['kernel'], n, axis=1)
+        outs = [{'kernel': np.ascontiguousarray(kk)} for kk in kernels]
+        if 'bias' in linear:
+            for out, bb in zip(outs, np.split(linear['bias'], n)):
+                out['bias'] = bb
+        return outs
+
+    h = cfg.hidden_size
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f'layers.{i}'
+        q, k, v = split_cols(lin(f'{p}.attn.Wqkv'), 3)
+        wi_in, wi_gate = split_cols(lin(f'{p}.mlp.Wi'), 2)
+        layers.append(
+            {
+                'attn_norm': ln(f'{p}.attn_norm', h),
+                'q': q,
+                'k': k,
+                'v': v,
+                'o': lin(f'{p}.attn.Wo'),
+                'mlp_norm': ln(f'{p}.mlp_norm', h),
+                'wi_in': wi_in,
+                'wi_gate': wi_gate,
+                'wo': lin(f'{p}.mlp.Wo'),
+            }
+        )
+    return {
+        'embed': sd['embeddings.tok_embeddings.weight'],
+        'embed_norm': ln('embeddings.norm', h),
+        'final_norm': ln('final_norm', h),
+        'layers': common.stack_layers(layers),
+        'attn_norm_flag': _attn_norm_flags(cfg),
+        'global_flag': _global_flags(cfg),
+    }

@@ -1,0 +1,149 @@
+"""ModernBERT JAX implementation vs transformers golden numerics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distllm_tpu.models import modernbert
+
+transformers = pytest.importorskip('transformers')
+
+
+def _tiny_hf_config():
+    from transformers import ModernBertConfig as HFConfig
+
+    return HFConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=5,  # layers 0,3 global; 1,2,4 local
+        num_attention_heads=4,
+        max_position_embeddings=128,
+        global_attn_every_n_layers=3,
+        local_attention=8,  # window small enough to matter at S=24
+        global_rope_theta=160000.0,
+        local_rope_theta=10000.0,
+        norm_eps=1e-5,
+        pad_token_id=0,
+        reference_compile=False,
+        attn_implementation='eager',
+    )
+
+
+@pytest.fixture(scope='module')
+def hf_model():
+    import torch
+
+    from transformers import ModernBertModel
+
+    torch.manual_seed(0)
+    model = ModernBertModel(_tiny_hf_config())
+    model.eval()
+    return model
+
+
+def test_matches_transformers(hf_model):
+    import torch
+
+    hf_cfg = hf_model.config.to_dict()
+    cfg = modernbert.ModernBertConfig.from_hf_config(hf_cfg)
+    cfg.dtype = 'float32'
+    assert cfg.num_layers == 5 and cfg.local_attention == 8
+
+    state = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+    params = modernbert.params_from_hf(state, cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 256, size=(3, 24)).astype(np.int64)
+    mask = np.ones((3, 24), np.int64)
+    mask[1, 17:] = 0  # padded row exercises the key-validity mask
+    ids[1, 17:] = 0
+
+    with torch.no_grad():
+        want = hf_model(
+            input_ids=torch.from_numpy(ids),
+            attention_mask=torch.from_numpy(mask),
+        ).last_hidden_state.numpy()
+
+    got = np.asarray(
+        modernbert.apply(
+            params, cfg, jnp.asarray(ids, jnp.int32),
+            jnp.asarray(mask, jnp.int32),
+        )
+    )
+    # Padded positions produce garbage in both stacks; compare valid rows.
+    np.testing.assert_allclose(got[0], want[0], atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(got[2], want[2], atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(
+        got[1, :17], want[1, :17], atol=2e-4, rtol=2e-4
+    )
+
+
+def test_local_window_actually_restricts(hf_model):
+    """Changing a token outside every local window must not change a far
+    position's output at local layers — but DOES reach it through global
+    layers; so instead verify our window mask logic directly against a
+    global-only variant: with local_attention >= 2*S the model must equal
+    a config where every layer is global."""
+    hf_cfg = hf_model.config.to_dict()
+    cfg = modernbert.ModernBertConfig.from_hf_config(hf_cfg)
+    cfg.dtype = 'float32'
+    cfg.local_attention = 4 * 24  # window covers everything
+    # Match thetas so ONLY the mask differs between local and global.
+    cfg.local_rope_theta = cfg.global_rope_theta
+    params = modernbert.init(jax.random.PRNGKey(0), cfg)
+
+    cfg_all_global = cfg.model_copy(
+        update={'global_attn_every_n_layers': 1}
+    )
+    params_all_global = dict(params)
+    params_all_global['global_flag'] = modernbert._global_flags(
+        cfg_all_global
+    )
+
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(1, 256, size=(2, 24)), jnp.int32)
+    mask = jnp.ones((2, 24), jnp.int32)
+    a = modernbert.apply(params, cfg, ids, mask)
+    b = modernbert.apply(params_all_global, cfg_all_global, ids, mask)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_auto_encoder_dispatches_modernbert(tmp_path):
+    """AutoEncoder routes model_type=modernbert through the JAX stack."""
+    import json
+
+    import torch
+
+    from transformers import ModernBertModel
+
+    torch.manual_seed(0)
+    model = ModernBertModel(_tiny_hf_config())
+    model.save_pretrained(tmp_path)
+    # Synthesize a minimal fast tokenizer on disk (zero egress).
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {'[UNK]': 0, '[PAD]': 1}
+    vocab.update({f'w{i}': i + 2 for i in range(100)})
+    tok_fast = Tokenizer(WordLevel(vocab, unk_token='[UNK]'))
+    tok_fast.pre_tokenizer = Whitespace()
+    tok_fast.save(str(tmp_path / 'tokenizer.json'))
+    (tmp_path / 'tokenizer_config.json').write_text(
+        json.dumps({'tokenizer_class': 'PreTrainedTokenizerFast',
+                    'pad_token': '[PAD]', 'unk_token': '[UNK]',
+                    'model_max_length': 128})
+    )
+
+    from distllm_tpu.embed.encoders.auto import AutoEncoder, AutoEncoderConfig
+
+    enc = AutoEncoder(
+        AutoEncoderConfig(
+            pretrained_model_name_or_path=str(tmp_path),
+            half_precision=False,
+        )
+    )
+    assert enc.embedding_size == 64
+    assert type(enc.model_cfg).__name__ == 'ModernBertConfig'
