@@ -270,6 +270,52 @@ def quantize_pytree(
     )
 
 
+def quantize_pytree_abstract(
+    shapes: Any,
+    mode: str = 'int8',
+    min_size: int = 4096,
+    make_leaf=None,
+) -> Any:
+    """Shape-level analogue of :func:`quantize_pytree` for AOT compiles.
+
+    Maps a tree of ``ShapeDtypeStruct``-like leaves to the pytree the real
+    quantizer would produce — same quantize-or-pass-through policy, same
+    code/scale shapes — without any data. ``make_leaf(shape, dtype)``
+    constructs abstract leaves (defaults to ``jax.ShapeDtypeStruct``).
+    Keeping this NEXT TO the quantizer means compile-only preflights and
+    CI lowering tests can't drift from the layout serving actually runs.
+    Currently int8 only (the AOT-validated serving mode).
+    """
+    import jax
+
+    if mode != 'int8':
+        raise NotImplementedError(f'abstract quantization for {mode!r}')
+    if make_leaf is None:
+        def make_leaf(shape, dtype):
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+    def convert(path, leaf):
+        if not _should_quantize(path, leaf, min_size):
+            return make_leaf(leaf.shape, leaf.dtype)
+        shape = tuple(leaf.shape)
+        # Mirrors quantize_int8: per-output-channel scales, keepdims over
+        # the reduced axes ([L, 1, out] for stacked 3-D, [1, out] for 2-D).
+        scale_shape = (
+            (shape[0], 1, shape[-1]) if len(shape) >= 3 else (1, shape[-1])
+        )
+        return QTensor(
+            make_leaf(shape, jnp.int8),
+            make_leaf(scale_shape, jnp.float32),
+            'int8',
+            shape,
+            'bfloat16',
+        )
+
+    return jax.tree_util.tree_map_with_path(
+        convert, shapes, is_leaf=lambda x: isinstance(x, QTensor)
+    )
+
+
 def dequantize_pytree(params: Any) -> Any:
     """Restore float arrays from :class:`QTensor` leaves (jit-safe)."""
     return jax.tree_util.tree_map(

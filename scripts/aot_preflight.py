@@ -1,0 +1,82 @@
+"""Compile-only preflight of the serving path against a v5e topology.
+
+Round 2's blind spot: the Pallas decode kernel only failed ON the chip
+(Mosaic lowering + HBM budgeting are invisible to CPU interpret tests).
+The locally installed libtpu can build a COMPILE-ONLY PJRT topology
+(``jax.experimental.topologies``) with no hardware attached, so every
+serving executable — bf16 batch-32 and int8 batch-128 fused decode
+windows, both attention backends, with the engine's AUTO-layout
+compile — can be validated for lowering errors and HBM fit before a
+single chip-second is spent. Run before benching; see also
+tests/test_aot_tpu.py for the small-dims CI version.
+"""
+
+import os
+os.environ.pop('JAX_PLATFORMS', None)
+import numpy as np
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import jax.numpy as jnp
+from jax.experimental import topologies
+from jax.experimental.layout import Format, Layout
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import pathlib, sys
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import time
+
+topo = topologies.get_topology_desc(platform='tpu', topology_name='v5e:2x2x1')
+mesh = Mesh(np.asarray(topo.devices[:1]).reshape(1), ('x',))
+s = NamedSharding(mesh, P())
+
+def sds(shape, dtype):
+    return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=s)
+
+from distllm_tpu.models import mistral
+from distllm_tpu.ops.quantization import quantize_pytree_abstract
+
+mcfg = mistral.MistralConfig(dtype='bfloat16')
+mshapes = jax.eval_shape(lambda: mistral.init_on_device(jax.random.PRNGKey(0), mcfg))
+bs = 16
+
+def window_args(params_tree, B, nb, R):
+    kshape = (mcfg.num_layers, nb, bs, mcfg.num_kv_heads, mcfg.head_size)
+    return (
+        params_tree, sds((B,), jnp.int32), sds((B,), jnp.int32),
+        sds((B,), jnp.int32), sds(kshape, jnp.bfloat16),
+        sds(kshape, jnp.bfloat16), sds((B, R), jnp.int32),
+        sds((B,), jnp.int32), sds((B,), jnp.float32),
+        sds((B,), jnp.float32), sds((B,), jnp.float32),
+        sds((2,), jnp.uint32),
+    )
+
+failures: list[str] = []
+
+
+def compile_window(params_tree, B, nb, R, backend, label):
+    t = time.perf_counter()
+    try:
+        fn = lambda p, i, po, c, k, v, bt, sl, tmp, tp, mp, ky: \
+            mistral.decode_loop(
+                p, mcfg, i, po, k, v, bt, c, sl, tmp, tp, mp, ky,
+                num_steps=16, attn_backend=backend, max_table_positions=512,
+                sampling_top_window=64)
+        jitted = jax.jit(fn, donate_argnums=(4, 5),
+                         in_shardings=(Format(Layout.AUTO),) + (Format(),) * 11)
+        compiled = jitted.lower(*window_args(params_tree, B, nb, R)).compile()
+        mem = compiled.memory_analysis()
+        tmp_b = getattr(mem, 'temp_size_in_bytes', None)
+        print(f'{label}: AOT OK ({time.perf_counter()-t:.0f}s) '
+              f'temp={tmp_b/1e9 if tmp_b else "?"}GB', flush=True)
+    except Exception as exc:
+        print(f'{label}: FAILED {repr(exc)[:400]}', flush=True)
+        failures.append(label)
+
+bf16_params = jax.tree.map(lambda x: sds(x.shape, x.dtype), mshapes)
+compile_window(bf16_params, 32, 712, 32, 'pallas', 'bf16 B=32 pallas AUTO-layout')
+compile_window(bf16_params, 32, 712, 32, 'xla', 'bf16 B=32 xla AUTO-layout')
+
+qparams = quantize_pytree_abstract(mshapes, make_leaf=sds)
+compile_window(qparams, 128, 2840, 32, 'pallas', 'int8 B=128 pallas AUTO-layout')
+compile_window(qparams, 128, 2840, 32, 'xla', 'int8 B=128 xla AUTO-layout')
+print('DONE' + (f' ({len(failures)} FAILED)' if failures else ''), flush=True)
+sys.exit(1 if failures else 0)

@@ -1,0 +1,131 @@
+"""Compile-only TPU (Mosaic) lowering tests — no hardware needed.
+
+The locally installed libtpu can build a compile-only PJRT topology
+(``jax.experimental.topologies``), which catches the class of failures CPU
+interpret mode cannot: Mosaic lowering rejections (block-shape rules, DMA
+patterns) and HBM budgeting. Round 2's flagship regression — a Pallas
+decode kernel that silently failed only on the real chip — is exactly what
+these tests pin down in CI. Small dims keep each compile to a few seconds;
+``scripts/aot_preflight.py`` runs the full 7B serving matrix.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+
+
+@pytest.fixture(scope='module')
+def v5e():
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    try:
+        topo = topologies.get_topology_desc(
+            platform='tpu', topology_name='v5e:2x2x1'
+        )
+    except Exception as exc:  # no libtpu / unsupported platform
+        pytest.skip(f'no compile-only TPU topology available: {exc!r}')
+    mesh = Mesh(np.asarray(topo.devices[:1]).reshape(1), ('x',))
+    sharding = NamedSharding(mesh, PartitionSpec())
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
+
+    return sds
+
+
+def test_encoder_attention_compiles_for_tpu(v5e):
+    from distllm_tpu.ops.encoder_attention import encoder_attention
+
+    # 160 is a fine-ladder rung that is NOT a multiple of 128 — the case
+    # the library flash kernel rejects and Mosaic block rules can trip on.
+    b, s, d = 8, 160, 256
+    jax.jit(
+        lambda q, k, v, m: encoder_attention(q, k, v, m, num_heads=4)
+    ).lower(
+        v5e((b, s, d), jnp.bfloat16),
+        v5e((b, s, d), jnp.bfloat16),
+        v5e((b, s, d), jnp.bfloat16),
+        v5e((b, s), jnp.int32),
+    ).compile()
+
+
+@pytest.mark.parametrize('backend', ['pallas', 'xla'])
+def test_decode_window_compiles_for_tpu(v5e, backend):
+    from distllm_tpu.models import mistral
+
+    # head_dim must be 128 (the Pallas kernel's DMA alignment contract).
+    cfg = mistral.MistralConfig(
+        vocab_size=2048, hidden_size=1024, num_layers=2, num_heads=8,
+        num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+    )
+    shapes = jax.eval_shape(
+        lambda: mistral.init_on_device(jax.random.PRNGKey(0), cfg)
+    )
+    params = jax.tree.map(lambda x: v5e(x.shape, x.dtype), shapes)
+    b, nb, bs, rows = 8, 64, 16, 16
+    kshape = (cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_size)
+    jax.jit(
+        lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky:
+            mistral.decode_loop(
+                p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                num_steps=4, attn_backend=backend, max_table_positions=256,
+                sampling_top_window=16,
+            ),
+        donate_argnums=(4, 5),
+    ).lower(
+        params, v5e((b,), jnp.int32), v5e((b,), jnp.int32),
+        v5e((b,), jnp.int32), v5e(kshape, jnp.bfloat16),
+        v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
+        v5e((b,), jnp.int32), v5e((b,), jnp.float32),
+        v5e((b,), jnp.float32), v5e((b,), jnp.float32),
+        v5e((2,), jnp.uint32),
+    ).compile()
+
+
+def test_int8_decode_window_compiles_for_tpu(v5e):
+    """Per-layer dequant inside the scan must not materialize the float
+    stack as HLO temps (the whole-tree dequant OOMed 7B on 16 GiB)."""
+    from distllm_tpu.models import mistral
+    from distllm_tpu.ops.quantization import quantize_pytree_abstract
+
+    # head_dim must be 128 (the Pallas kernel's DMA alignment contract).
+    cfg = mistral.MistralConfig(
+        vocab_size=2048, hidden_size=1024, num_layers=2, num_heads=8,
+        num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+    )
+    shapes = jax.eval_shape(
+        lambda: mistral.init_on_device(jax.random.PRNGKey(0), cfg)
+    )
+
+    params = quantize_pytree_abstract(shapes, make_leaf=v5e)
+    float_stack_bytes = sum(
+        int(np.prod(x.shape)) * 2  # the bf16 stack a whole-tree dequant
+        for x in jax.tree.leaves(shapes)  # would materialize as HLO temps
+    )
+    b, nb, bs, rows = 8, 64, 16, 16
+    kshape = (cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_size)
+    compiled = jax.jit(
+        lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky:
+            mistral.decode_loop(
+                p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                num_steps=4, attn_backend='pallas', max_table_positions=256,
+                sampling_top_window=16,
+            ),
+        donate_argnums=(4, 5),
+    ).lower(
+        params, v5e((b,), jnp.int32), v5e((b,), jnp.int32),
+        v5e((b,), jnp.int32), v5e(kshape, jnp.bfloat16),
+        v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
+        v5e((b,), jnp.int32), v5e((b,), jnp.float32),
+        v5e((b,), jnp.float32), v5e((b,), jnp.float32),
+        v5e((2,), jnp.uint32),
+    ).compile()
+    mem = compiled.memory_analysis()
+    temp = getattr(mem, 'temp_size_in_bytes', None)
+    if temp is not None:
+        # A whole-tree dequant would materialize the full bf16 stack
+        # (float_stack_bytes) as temps; per-layer dequant stays well under.
+        assert temp < float_stack_bytes // 2
