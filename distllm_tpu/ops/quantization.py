@@ -275,6 +275,7 @@ def quantize_pytree_abstract(
     mode: str = 'int8',
     min_size: int = 4096,
     make_leaf=None,
+    out_dtype: str = 'bfloat16',
 ) -> Any:
     """Shape-level analogue of :func:`quantize_pytree` for AOT compiles.
 
@@ -284,10 +285,10 @@ def quantize_pytree_abstract(
     constructs abstract leaves (defaults to ``jax.ShapeDtypeStruct``).
     Keeping this NEXT TO the quantizer means compile-only preflights and
     CI lowering tests can't drift from the layout serving actually runs.
-    Currently int8 only (the AOT-validated serving mode).
+    Currently int8 only (the AOT-validated serving mode). ``out_dtype``
+    must match what the real quantizer is called with (the engine passes
+    the model dtype) or the compiled program diverges from serving.
     """
-    import jax
-
     if mode != 'int8':
         raise NotImplementedError(f'abstract quantization for {mode!r}')
     if make_leaf is None:
@@ -295,6 +296,8 @@ def quantize_pytree_abstract(
             return jax.ShapeDtypeStruct(tuple(shape), dtype)
 
     def convert(path, leaf):
+        if isinstance(leaf, QTensor):
+            return leaf
         if not _should_quantize(path, leaf, min_size):
             return make_leaf(leaf.shape, leaf.dtype)
         shape = tuple(leaf.shape)
@@ -308,7 +311,7 @@ def quantize_pytree_abstract(
             make_leaf(scale_shape, jnp.float32),
             'int8',
             shape,
-            'bfloat16',
+            out_dtype,
         )
 
     return jax.tree_util.tree_map_with_path(

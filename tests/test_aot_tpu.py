@@ -100,10 +100,17 @@ def test_int8_decode_window_compiles_for_tpu(v5e):
         lambda: mistral.init_on_device(jax.random.PRNGKey(0), cfg)
     )
 
+    from distllm_tpu.ops.quantization import QTensor
+
     params = quantize_pytree_abstract(shapes, make_leaf=v5e)
+    # Bytes a whole-tree dequant would materialize as bf16 HLO temps:
+    # only the leaves that actually became QTensor.
     float_stack_bytes = sum(
-        int(np.prod(x.shape)) * 2  # the bf16 stack a whole-tree dequant
-        for x in jax.tree.leaves(shapes)  # would materialize as HLO temps
+        int(np.prod(leaf.shape)) * 2
+        for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)
+        )
+        if isinstance(leaf, QTensor)
     )
     b, nb, bs, rows = 8, 64, 16, 16
     kshape = (cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_size)
