@@ -164,4 +164,42 @@ def compile_multichip() -> None:
 
 compile_multichip()
 print('MULTICHIP DONE', flush=True)
+
+
+# ---- embed-stage executables: the bench's other warmup set. Mirrors
+# JaxEncoder.pooled_forward's fused graph (encode -> mean pool -> fp32).
+def compile_embed_set() -> None:
+    from distllm_tpu.embed import get_pooler
+    from distllm_tpu.models import bert
+    from distllm_tpu.ops.quantization import quantize_pytree_abstract
+
+    cfg = bert.BertConfig(dtype='bfloat16')
+    host = bert.init(jax.random.PRNGKey(0), cfg)
+    f32_params = jax.tree.map(lambda x: sds(np.shape(x), jnp.float32), host)
+    del host
+    int8_params = quantize_pytree_abstract(f32_params, make_leaf=sds)
+    pooler = get_pooler({'name': 'mean'})
+
+    def fused(p, ids, mask):
+        pooled = pooler.pool(bert.apply(p, cfg, ids, mask), mask)
+        return pooled.astype(jnp.float32)
+
+    for label, params in (('f32', f32_params), ('int8', int8_params)):
+        for S in (160, 192, 224, 256, 288, 320, 352):
+            t = time.perf_counter()
+            try:
+                jax.jit(fused).lower(
+                    params, sds((512, S), jnp.int32), sds((512, S), jnp.int32)
+                ).compile()
+                print(f'embed fused {label} S={S}: AOT OK '
+                      f'({time.perf_counter()-t:.0f}s)', flush=True)
+            except Exception as exc:
+                print(f'embed fused {label} S={S}: FAILED '
+                      f'{repr(exc)[:300]}', flush=True)
+                failures.append(f'embed-{label}-{S}')
+
+
+compile_embed_set()
+print('EMBED SET DONE' + (f' ({len(failures)} FAILED)' if failures else ''),
+      flush=True)
 sys.exit(1 if failures else 0)
