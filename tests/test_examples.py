@@ -25,6 +25,7 @@ def test_examples_tree_exists():
         ('embed/jsonl_chunk.fake.local.yaml', 'embed'),
         ('embed/semantic_chunk.sfr-mistral.pod-pbs.nodes256.yaml', 'embed'),
         ('embed/esm2.fasta.workstation.yaml', 'embed'),
+        ('embed/modernbert.jsonl_chunk.workstation.yaml', 'embed'),
         ('generate/question_chunk.fake.local.yaml', 'generate'),
         ('generate/mistral7b.tpu.pod-slurm.nodes16.yaml', 'generate'),
         ('tokenize/jsonl.local.yaml', 'tokenize'),
