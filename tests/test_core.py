@@ -208,3 +208,24 @@ class TestInstantiate:
         from distllm_tpu.utils import instantiate
 
         assert instantiate({'a': [1, 2]}) == {'a': [1, 2]}
+
+
+def test_apply_platform_env_honors_env(monkeypatch):
+    """apply_platform_env re-applies JAX_PLATFORMS through the config API
+    (the pinned-platform image's sitecustomize beats the bare env var)."""
+    import jax
+
+    from distllm_tpu.utils import apply_platform_env
+
+    before = jax.config.jax_platforms
+    try:
+        monkeypatch.setenv('JAX_PLATFORMS', 'cpu')
+        apply_platform_env()
+        assert jax.config.jax_platforms == 'cpu'
+        # Unset env leaves the config untouched.
+        monkeypatch.delenv('JAX_PLATFORMS')
+        jax.config.update('jax_platforms', 'cpu')
+        apply_platform_env()
+        assert jax.config.jax_platforms == 'cpu'
+    finally:
+        jax.config.update('jax_platforms', before)
