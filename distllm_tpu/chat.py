@@ -78,6 +78,8 @@ def make_http_generator(
 
     return ApiGenerator(
         ApiGeneratorConfig(
+            provider='openai',  # an OpenAI-compatible server, whatever the
+            # served model is named (e.g. a proxy hosting 'claude-*')
             openai_api_base=base_url,
             model=model,
             api_key=api_key,

@@ -34,6 +34,7 @@ def compute_embeddings(
     batch_size: int,
     normalize: bool = False,
     flush_every: int = 64,
+    max_resident_groups: int = 8,
 ) -> np.ndarray:
     """Embed ``texts`` → host ``[N, H]`` float32 array in original order.
 
@@ -42,8 +43,10 @@ def compute_embeddings(
     tokenization of batch *i+1* overlaps device compute of batch *i*. Every
     ``flush_every`` batches the pooled rows are concatenated ON DEVICE into
     one array whose host copy starts asynchronously (one device→host round
-    trip per group rather than per batch); all groups are gathered into the
-    host buffer once the loop ends.
+    trip per group rather than per batch). At most ``max_resident_groups``
+    sealed groups stay on device: past that the oldest (whose async copy has
+    had the longest to land) is drained into the host buffer, so device
+    residency stays O(flush_every · batch · H) rather than O(corpus).
     """
     n = len(texts)
     out = np.empty((n, encoder.embedding_size), dtype=np.float32)
@@ -67,6 +70,10 @@ def compute_embeddings(
         else None
     )
 
+    def drain_group() -> None:
+        idx_all, group = groups.pop(0)
+        out[idx_all] = np.asarray(group, dtype=np.float32)
+
     def seal_group() -> None:
         if not pending:
             return
@@ -78,6 +85,11 @@ def compute_embeddings(
             copy_async()  # overlaps later groups' compute
         groups.append((idx_all, group))
         pending.clear()
+        # Bound device residency: drain the OLDEST group (its async copy has
+        # had the longest to complete, so this rarely blocks) once more than
+        # max_resident_groups are outstanding.
+        while len(groups) > max_resident_groups:
+            drain_group()
 
     for lo in range(0, n, batch_size):
         idx = order[lo : lo + batch_size]
@@ -98,8 +110,8 @@ def compute_embeddings(
         if len(pending) >= flush_every:
             seal_group()
     seal_group()
-    for idx_all, group in groups:
-        out[idx_all] = np.asarray(group, dtype=np.float32)
+    while groups:
+        drain_group()
     return out
 
 

@@ -1,10 +1,20 @@
-"""API generator: OpenAI-compatible chat-completions over HTTP.
+"""API generator: hosted-LLM chat over HTTP (OpenAI, Anthropic, Google).
 
-Reference parity: ``generate/generators/langchain_backend.py`` — the
-reference drives gpt/gemini/claude through LangChain's LLMChain; langchain
-is unavailable here, so this talks the OpenAI-compatible wire protocol
-directly (``requests``), which also covers our own chat server and any
-vLLM-style endpoint. Registered under both ``api`` and ``langchain``.
+Reference parity: ``generate/generators/langchain_backend.py:50-103`` — the
+reference drives gpt-3.5/gpt-4, gemini-pro, and claude-3-opus through
+LangChain's LLMChain, picking the provider class by model name. langchain
+is unavailable here, so each provider's wire protocol is spoken natively
+(``requests``):
+
+- ``openai``   — ``POST {base}/chat/completions`` (also covers our own
+  chat server and any vLLM-style endpoint);
+- ``anthropic`` — ``POST {base}/v1/messages`` (Messages API);
+- ``google``   — ``POST {base}/v1beta/models/{model}:generateContent``
+  (Generative Language API).
+
+``provider='auto'`` infers from the model name exactly as the reference's
+chain selection does: ``claude*`` → anthropic, ``gemini*`` → google,
+anything else → openai. Registered under both ``api`` and ``langchain``.
 API keys come from the environment (reference uses dotenv).
 """
 
@@ -17,20 +27,41 @@ from pydantic import Field
 
 from distllm_tpu.utils import BaseConfig, expo_backoff_retry
 
+_KEY_ENVS = {
+    'openai': 'OPENAI_API_KEY',
+    'anthropic': 'ANTHROPIC_API_KEY',
+    'google': 'GOOGLE_API_KEY',
+}
+
 
 class ApiAuthError(Exception):
     """HTTP 401/403 from the endpoint — retrying cannot help."""
 
 
+class ApiResponseError(Exception):
+    """A 200 response whose body carries no generatable text (e.g. a
+    Gemini safety block) — deterministic, so retrying cannot help."""
+
+
 class ApiGeneratorConfig(BaseConfig):
     name: Literal['api', 'langchain'] = 'api'
+    provider: Literal['auto', 'openai', 'anthropic', 'google'] = Field(
+        default='auto',
+        description="Wire protocol; 'auto' infers from the model name "
+        "(claude* -> anthropic, gemini* -> google, else openai).",
+    )
     openai_api_base: str = 'https://api.openai.com/v1'
+    anthropic_api_base: str = 'https://api.anthropic.com'
+    anthropic_version: str = '2023-06-01'
+    google_api_base: str = 'https://generativelanguage.googleapis.com'
     model: str = 'gpt-3.5-turbo'
     api_key: str = Field(
         default='', description='Inline API key (takes precedence).'
     )
     api_key_env: str = Field(
-        default='OPENAI_API_KEY', description='Env var holding the API key.'
+        default='',
+        description='Env var holding the API key; defaults per provider '
+        '(OPENAI_API_KEY / ANTHROPIC_API_KEY / GOOGLE_API_KEY).',
     )
     temperature: float = 0.0
     max_tokens: int = 512
@@ -48,43 +79,132 @@ class ApiGeneratorConfig(BaseConfig):
         "style 'user' fields).",
     )
 
+    def resolved_provider(self) -> str:
+        if self.provider != 'auto':
+            return self.provider
+        model = self.model.lower()
+        if model.startswith('claude'):
+            return 'anthropic'
+        if model.startswith('gemini'):
+            return 'google'
+        return 'openai'
+
 
 class ApiGenerator:
     def __init__(self, config: ApiGeneratorConfig) -> None:
         self.config = config
+        self.provider = config.resolved_provider()
+
+    def _api_key(self) -> str:
+        if self.config.api_key:
+            return self.config.api_key
+        env = self.config.api_key_env or _KEY_ENVS[self.provider]
+        return os.environ.get(env, '')
+
+    def _request(self, prompt: str) -> tuple[str, dict, dict]:
+        """(url, headers, body) for one prompt on the resolved provider."""
+        cfg = self.config
+        key = self._api_key()
+        if self.provider == 'anthropic':
+            headers = {'Content-Type': 'application/json',
+                       'anthropic-version': cfg.anthropic_version}
+            if key:
+                headers['x-api-key'] = key
+            return (
+                f'{cfg.anthropic_api_base.rstrip("/")}/v1/messages',
+                headers,
+                {
+                    'model': cfg.model,
+                    'max_tokens': cfg.max_tokens,
+                    'temperature': cfg.temperature,
+                    'messages': [{'role': 'user', 'content': prompt}],
+                    **cfg.extra_body,
+                },
+            )
+        if self.provider == 'google':
+            url = (
+                f'{cfg.google_api_base.rstrip("/")}/v1beta/models/'
+                f'{cfg.model}:generateContent'
+            )
+            # Key goes in a header, never the URL: exception messages and
+            # request logs format the URL verbatim.
+            headers = {'Content-Type': 'application/json'}
+            if key:
+                headers['x-goog-api-key'] = key
+            gen_config = {
+                'temperature': cfg.temperature,
+                'maxOutputTokens': cfg.max_tokens,
+            }
+            extra = dict(cfg.extra_body)
+            # Google nests sampling knobs under generationConfig; merge an
+            # extra_body generationConfig there instead of clobbering it.
+            gen_config.update(extra.pop('generationConfig', {}))
+            return (
+                url,
+                headers,
+                {
+                    'contents': [{'parts': [{'text': prompt}]}],
+                    'generationConfig': gen_config,
+                    **extra,
+                },
+            )
+        headers = {'Content-Type': 'application/json'}
+        if key:
+            headers['Authorization'] = f'Bearer {key}'
+        return (
+            f'{cfg.openai_api_base.rstrip("/")}/chat/completions',
+            headers,
+            {
+                'model': cfg.model,
+                'messages': [{'role': 'user', 'content': prompt}],
+                'temperature': cfg.temperature,
+                'max_tokens': cfg.max_tokens,
+                **cfg.extra_body,
+            },
+        )
+
+    def _parse(self, payload: dict) -> str:
+        if self.provider == 'anthropic':
+            return ''.join(
+                block.get('text', '')
+                for block in payload['content']
+                if block.get('type', 'text') == 'text'
+            )
+        if self.provider == 'google':
+            candidates = payload.get('candidates') or []
+            if not candidates or 'content' not in candidates[0]:
+                # Safety-blocked / empty responses are deterministic:
+                # surface the reason instead of retrying the bill.
+                reason = (
+                    candidates[0].get('finishReason')
+                    if candidates
+                    else payload.get('promptFeedback')
+                )
+                raise ApiResponseError(
+                    f'no generatable content (reason: {reason!r})'
+                )
+            parts = candidates[0]['content'].get('parts', [])
+            return ''.join(p.get('text', '') for p in parts)
+        return payload['choices'][0]['message']['content']
 
     def _chat(self, prompt: str) -> str:
         import requests
 
-        headers = {'Content-Type': 'application/json'}
-        api_key = self.config.api_key or os.environ.get(
-            self.config.api_key_env, ''
-        )
-        if api_key:
-            headers['Authorization'] = f'Bearer {api_key}'
+        url, headers, body = self._request(prompt)
 
         def call() -> str:
             response = requests.post(
-                f'{self.config.openai_api_base.rstrip("/")}/chat/completions',
-                json={
-                    'model': self.config.model,
-                    'messages': [{'role': 'user', 'content': prompt}],
-                    'temperature': self.config.temperature,
-                    'max_tokens': self.config.max_tokens,
-                    **self.config.extra_body,
-                },
-                headers=headers,
-                timeout=self.config.timeout,
+                url, json=body, headers=headers, timeout=self.config.timeout
             )
             if response.status_code in (401, 403):
-                raise ApiAuthError(
-                    f'{response.status_code} from {self.config.openai_api_base}'
-                )
+                raise ApiAuthError(f'{response.status_code} from {url}')
             response.raise_for_status()
-            return response.json()['choices'][0]['message']['content']
+            return self._parse(response.json())
 
         return expo_backoff_retry(
-            call, max_tries=self.config.max_tries, give_up_on=(ApiAuthError,)
+            call,
+            max_tries=self.config.max_tries,
+            give_up_on=(ApiAuthError, ApiResponseError),
         )
 
     def generate(self, prompts: str | list[str]) -> list[str]:

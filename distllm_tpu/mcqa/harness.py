@@ -229,6 +229,7 @@ def run_mcqa(config: MCQAConfig) -> dict[str, Any]:
 
     model_client = ApiGenerator(
         ApiGeneratorConfig(
+            provider='openai',
             openai_api_base=model_base,
             model=model_name,
             api_key=model_key,
@@ -246,6 +247,7 @@ def run_mcqa(config: MCQAConfig) -> dict[str, Any]:
     grader_base, grader_key, grader_model = config.resolve_grader_endpoint()
     grader_client = ApiGenerator(
         ApiGeneratorConfig(
+            provider='openai',
             openai_api_base=grader_base,
             model=grader_model,
             api_key=grader_key,

@@ -149,12 +149,28 @@ def apply(
     cfg: ModernBertConfig,
     input_ids: jnp.ndarray,  # [B, S]
     attention_mask: jnp.ndarray,  # [B, S]
+    attn_impl: str = 'auto',
 ) -> jnp.ndarray:
-    """Forward: ``[B, S]`` ids/mask → ``[B, S, H]`` final hidden states."""
+    """Forward: ``[B, S]`` ids/mask → ``[B, S, H]`` final hidden states.
+
+    ``attn_impl`` as in ``bert.apply`` (shared policy,
+    ops/encoder_attention.py resolve_use_pallas); the Pallas path carries
+    the sliding-window mask of local layers as an additive ``[S, S]`` bias,
+    so both global and local layers run the kernel.
+    """
     dtype = jnp.dtype(cfg.dtype)
     act = common.ACTIVATIONS[cfg.hidden_act]
     seq = input_ids.shape[1]
     eps = cfg.norm_eps
+    from distllm_tpu.ops.encoder_attention import (
+        encoder_attention,
+        resolve_use_pallas,
+    )
+
+    use_pallas = resolve_use_pallas(
+        attn_impl, seq, cfg.hidden_size, cfg.num_heads, cfg.dtype,
+        has_bias=True,
+    )
 
     def maybe_bias(p):
         return p.get('bias') if isinstance(p, dict) else None
@@ -180,6 +196,9 @@ def apply(
     )
     window = (distance <= cfg.local_attention // 2)[None, None]
     local_valid = key_valid & window
+    # Pallas path: the window becomes an additive [S, S] score bias (key
+    # padding rides separately as the kernel's [B, S] mask operand).
+    window_bias = jnp.where(window[0, 0], 0.0, -1e9).astype(jnp.float32)
 
     x = ln(jnp.asarray(params['embed'])[input_ids], params['embed_norm'])
 
@@ -209,8 +228,20 @@ def apply(
         sin = jnp.where(is_global, sin_g, sin_l)
         q = common.apply_rope(q, cos, sin)
         k = common.apply_rope(k, cos, sin)
-        mask = jnp.where(is_global, key_valid, local_valid)
-        attn = common.merge_heads(common.sdpa(q, k, v, mask=mask))
+        if use_pallas:
+            # merge_heads is a reshape (no transpose); heads stay packed.
+            # Global layers zero the window bias via the traced flag.
+            attn = encoder_attention(
+                common.merge_heads(q),
+                common.merge_heads(k),
+                common.merge_heads(v),
+                attention_mask,
+                cfg.num_heads,
+                bias=jnp.where(is_global, 0.0, window_bias),
+            )
+        else:
+            mask = jnp.where(is_global, key_valid, local_valid)
+            attn = common.merge_heads(common.sdpa(q, k, v, mask=mask))
         x = x + common.dense(attn, lp['o']['kernel'], maybe_bias(lp['o']))
         normed2 = ln(x, lp['mlp_norm'])
         gate_in = common.dense(

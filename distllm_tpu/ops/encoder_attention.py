@@ -48,17 +48,21 @@ _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 
 
 def shape_supported(
-    seq_len: int, hidden: int, num_heads: int, itemsize: int = 2
+    seq_len: int, hidden: int, num_heads: int, itemsize: int = 2,
+    has_bias: bool = False,
 ) -> bool:
     """True when this kernel can run the shape: S % 32 == 0, head_dim % 8
     == 0, and the per-grid-step working set (double-buffered Q/K/V/O blocks
-    + the [S, S] fp32 score tile) fits in VMEM. Callers fall back to XLA
-    SDPA otherwise (e.g. ESM2-3B's hidden=2560 at S=512). ``itemsize`` is
-    the activation dtype's bytes (2 for bf16, 4 for fp32 parity runs)."""
+    + the [S, S] fp32 score tile, doubled when an additive ``[S, S]`` bias
+    rides along) fits in VMEM. Callers fall back to XLA SDPA otherwise
+    (e.g. ESM2-3B's hidden=2560 at S=512). ``itemsize`` is the activation
+    dtype's bytes (2 for bf16, 4 for fp32 parity runs)."""
     if seq_len % 32 or hidden % num_heads or (hidden // num_heads) % 8:
         return False
     blocks = 4 * seq_len * hidden * itemsize * 2  # q/k/v/o, double-buffered
-    scores = seq_len * seq_len * 4
+    # Bias is an input operand too, so cost it double-buffered like the
+    # blocks, on top of the in-kernel [S, S] fp32 score tile.
+    scores = seq_len * seq_len * 4 * (3 if has_bias else 1)
     return blocks + scores <= _VMEM_BUDGET_BYTES
 
 
@@ -68,6 +72,7 @@ def resolve_use_pallas(
     hidden: int,
     num_heads: int,
     dtype,
+    has_bias: bool = False,
 ) -> bool:
     """Shared encoder-model policy for ``attn_impl``: ``'pallas'`` forces
     the kernel, ``'auto'`` picks it on TPU when :func:`shape_supported`,
@@ -78,12 +83,16 @@ def resolve_use_pallas(
     if attn_impl != 'auto':
         return False
     return jax.default_backend() == 'tpu' and shape_supported(
-        seq_len, hidden, num_heads, jnp.dtype(dtype).itemsize
+        seq_len, hidden, num_heads, jnp.dtype(dtype).itemsize, has_bias
     )
 
 
-def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, num_heads: int,
-            scale: float):
+def _kernel(q_ref, k_ref, v_ref, mask_ref, *rest, num_heads: int,
+            scale: float, has_bias: bool):
+    if has_bias:
+        bias_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
     seq, dim = q_ref.shape[1], q_ref.shape[2]
     head_dim = dim // num_heads
     # [S] key-validity bias, shared by every head of this batch row. (The
@@ -91,6 +100,12 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, num_heads: int,
     # to divide (8, 128) or equal the array's, which a [1, S] block of a
     # [B, S] array does not.)
     bias = jnp.where(mask_ref[0, 0] != 0, 0.0, _NEG_BIG).astype(jnp.float32)
+    if has_bias:
+        # Additive [S, S] term (e.g. ModernBERT's sliding-window mask),
+        # shared by every head and batch row; folded into the key bias.
+        bias = bias[None, :] + bias_ref[...].astype(jnp.float32)
+    else:
+        bias = bias[None, :]
     for h in range(num_heads):
         lo = h * head_dim
         qh = q_ref[0, :, lo:lo + head_dim]
@@ -100,7 +115,7 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, num_heads: int,
             qh, kh, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        scores = scores * scale + bias[None, :]
+        scores = scores * scale + bias
         m = jnp.max(scores, axis=-1, keepdims=True)
         p = jnp.exp(scores - m)
         p = p / jnp.sum(p, axis=-1, keepdims=True)
@@ -121,35 +136,48 @@ def encoder_attention(
     mask: jnp.ndarray,  # [B, S] nonzero = valid key
     num_heads: int,
     scale: float | None = None,
+    bias: jnp.ndarray | None = None,  # [S, S] additive fp32 score term
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Bidirectional multi-head attention, heads packed in the last dim."""
+    """Bidirectional multi-head attention, heads packed in the last dim.
+
+    ``bias``, when given, is an additive ``[S, S]`` score term shared by
+    every batch row and head — ModernBERT's sliding-window mask
+    (``models/modernbert.py``) or any relative-position bias.
+    """
     b, s, d = q.shape
     if d % num_heads:
         raise ValueError(f'hidden {d} not divisible by {num_heads} heads')
     if scale is None:
         scale = (d // num_heads) ** -0.5
+    has_bias = bias is not None
     kernel = functools.partial(_kernel, num_heads=num_heads,
-                               scale=float(scale))
+                               scale=float(scale), has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, 1, s), lambda i: (i, 0, 0)),
+    ]
+    operands = [q, k, v, mask.astype(jnp.int32).reshape(b, 1, s)]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((s, s), lambda i: (0, 0)))
+        operands.append(bias.astype(jnp.float32))
     return pl.pallas_call(
         kernel,
         grid=(b,),
-        in_specs=[
-            pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda i: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, s, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('arbitrary',),
         ),
         interpret=interpret,
-    )(q, k, v, mask.astype(jnp.int32).reshape(b, 1, s))
+    )(*operands)
 
 
-def encoder_attention_reference(q, k, v, mask, num_heads, scale=None):
+def encoder_attention_reference(q, k, v, mask, num_heads, scale=None,
+                                bias=None):
     """Pure-jnp oracle for tests (same layout/mask semantics)."""
     b, s, d = q.shape
     hd = d // num_heads
@@ -159,7 +187,9 @@ def encoder_attention_reference(q, k, v, mask, num_heads, scale=None):
     kh = k.reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)
     vh = v.reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)
     scores = jnp.einsum('bnqh,bnkh->bnqk', qh, kh).astype(jnp.float32) * scale
-    bias = jnp.where(mask[:, None, None, :] != 0, 0.0, _NEG_BIG)
-    p = jax.nn.softmax(scores + bias, axis=-1)
+    score_bias = jnp.where(mask[:, None, None, :] != 0, 0.0, _NEG_BIG)
+    if bias is not None:
+        score_bias = score_bias + bias[None, None].astype(jnp.float32)
+    p = jax.nn.softmax(scores + score_bias, axis=-1)
     out = jnp.einsum('bnqk,bnkh->bnqh', p.astype(vh.dtype), vh)
     return out.transpose(0, 2, 1, 3).reshape(b, s, d)

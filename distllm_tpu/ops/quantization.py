@@ -258,7 +258,10 @@ def quantize_pytree(
             path, leaf, min_size
         ):
             return leaf
-        host = np.asarray(leaf)
+        # An owned fp32 copy, not np.asarray: on some backends asarray of a
+        # jax.Array is a zero-copy view into the device/host buffer, which
+        # delete() below would free out from under the quantizer.
+        host = np.array(leaf, dtype=np.float32, copy=True)
         if delete_source and hasattr(leaf, 'delete'):
             leaf.delete()
         if mode == 'int8':

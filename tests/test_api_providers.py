@@ -1,0 +1,181 @@
+"""Native provider wires of the API generator (openai/anthropic/google).
+
+Reference parity: ``distllm/generate/generators/langchain_backend.py:50-103``
+selects an LLM class per model name (gpt → OpenAI, gemini-pro → Google,
+claude-3-opus → Anthropic); here each wire is spoken natively and selection
+follows the same model-name convention.
+"""
+
+
+import pytest
+
+from distllm_tpu.generate.generators.api_backend import (
+    ApiGenerator,
+    ApiGeneratorConfig,
+)
+
+
+class _Resp:
+    def __init__(self, payload):
+        self.payload = payload
+        self.status_code = 200
+
+    def raise_for_status(self):
+        pass
+
+    def json(self):
+        return self.payload
+
+
+@pytest.fixture
+def capture(monkeypatch):
+    calls = []
+
+    def fake_post(url, json=None, headers=None, timeout=None):
+        calls.append({'url': url, 'body': json, 'headers': headers})
+        return _Resp(fake_post.payload)
+
+    import requests
+
+    monkeypatch.setattr(requests, 'post', fake_post)
+    fake_post.calls = calls
+    return fake_post
+
+
+def test_auto_provider_inference():
+    assert ApiGeneratorConfig(model='gpt-4').resolved_provider() == 'openai'
+    assert (
+        ApiGeneratorConfig(model='claude-3-opus').resolved_provider()
+        == 'anthropic'
+    )
+    assert (
+        ApiGeneratorConfig(model='gemini-pro').resolved_provider() == 'google'
+    )
+    # Explicit provider beats the name heuristic (proxies rename models).
+    assert (
+        ApiGeneratorConfig(
+            model='claude-3-opus', provider='openai'
+        ).resolved_provider()
+        == 'openai'
+    )
+
+
+def test_openai_wire(capture):
+    capture.payload = {
+        'choices': [{'message': {'content': 'hello'}}]
+    }
+    gen = ApiGenerator(
+        ApiGeneratorConfig(model='gpt-4', api_key='sk-test', max_tries=1)
+    )
+    assert gen.generate('hi') == ['hello']
+    call = capture.calls[0]
+    assert call['url'].endswith('/chat/completions')
+    assert call['headers']['Authorization'] == 'Bearer sk-test'
+    assert call['body']['messages'] == [{'role': 'user', 'content': 'hi'}]
+
+
+def test_anthropic_wire(capture):
+    capture.payload = {
+        'content': [{'type': 'text', 'text': 'from claude'}]
+    }
+    gen = ApiGenerator(
+        ApiGeneratorConfig(
+            model='claude-3-opus', api_key='ak-test', max_tries=1,
+            max_tokens=77,
+        )
+    )
+    assert gen.generate(['q']) == ['from claude']
+    call = capture.calls[0]
+    assert call['url'].endswith('/v1/messages')
+    assert call['headers']['x-api-key'] == 'ak-test'
+    assert 'anthropic-version' in call['headers']
+    assert call['body']['max_tokens'] == 77
+    assert call['body']['messages'] == [{'role': 'user', 'content': 'q'}]
+
+
+def test_google_wire(capture):
+    capture.payload = {
+        'candidates': [
+            {'content': {'parts': [{'text': 'from gemini'}]}}
+        ]
+    }
+    gen = ApiGenerator(
+        ApiGeneratorConfig(
+            model='gemini-pro', api_key='gk-test', max_tries=1,
+            temperature=0.5,
+        )
+    )
+    assert gen.generate(['q']) == ['from gemini']
+    call = capture.calls[0]
+    assert ':generateContent' in call['url']
+    assert call['headers']['x-goog-api-key'] == 'gk-test'
+    assert call['body']['contents'] == [{'parts': [{'text': 'q'}]}]
+    assert call['body']['generationConfig']['temperature'] == 0.5
+
+
+def test_provider_key_env_defaults(monkeypatch, capture):
+    capture.payload = {'content': [{'type': 'text', 'text': 'ok'}]}
+    monkeypatch.setenv('ANTHROPIC_API_KEY', 'env-key')
+    gen = ApiGenerator(
+        ApiGeneratorConfig(model='claude-3-haiku', max_tries=1)
+    )
+    gen.generate('x')
+    assert capture.calls[0]['headers']['x-api-key'] == 'env-key'
+
+
+def test_multi_part_anthropic_response(capture):
+    capture.payload = {
+        'content': [
+            {'type': 'text', 'text': 'a'},
+            {'type': 'tool_use', 'id': 't'},
+            {'type': 'text', 'text': 'b'},
+        ]
+    }
+    gen = ApiGenerator(
+        ApiGeneratorConfig(model='claude-3-opus', max_tries=1)
+    )
+    assert gen.generate('x') == ['ab']
+
+
+def test_google_key_in_header_not_url(capture):
+    capture.payload = {
+        'candidates': [{'content': {'parts': [{'text': 'ok'}]}}]
+    }
+    gen = ApiGenerator(
+        ApiGeneratorConfig(model='gemini-pro', api_key='gk-secret',
+                           max_tries=1)
+    )
+    gen.generate('x')
+    call = capture.calls[0]
+    assert 'gk-secret' not in call['url']
+    assert call['headers']['x-goog-api-key'] == 'gk-secret'
+
+
+def test_google_safety_block_no_retry(capture):
+    from distllm_tpu.generate.generators.api_backend import ApiResponseError
+
+    capture.payload = {'candidates': [{'finishReason': 'SAFETY'}]}
+    gen = ApiGenerator(
+        ApiGeneratorConfig(model='gemini-pro', max_tries=5)
+    )
+    with pytest.raises(ApiResponseError, match='SAFETY'):
+        gen.generate('x')
+    assert len(capture.calls) == 1  # deterministic block: no re-billing
+
+
+def test_google_extra_generation_config_merges(capture):
+    capture.payload = {
+        'candidates': [{'content': {'parts': [{'text': 'ok'}]}}]
+    }
+    gen = ApiGenerator(
+        ApiGeneratorConfig(
+            model='gemini-pro', max_tries=1,
+            extra_body={'generationConfig': {'topP': 0.9},
+                        'safetySettings': [{'category': 'X'}]},
+        )
+    )
+    gen.generate('x')
+    body = capture.calls[0]['body']
+    assert body['generationConfig']['topP'] == 0.9
+    assert body['generationConfig']['maxOutputTokens'] == 512
+    assert body['safetySettings'] == [{'category': 'X'}]

@@ -74,6 +74,60 @@ def test_bfloat16_close(rng):
     )
 
 
+def test_additive_bias_matches_reference(rng):
+    b, s, d = 2, 64, 48
+    q, k, v = _case(rng, b, s, d, jnp.float32)
+    mask = jnp.asarray([[1] * 40 + [0] * 24, [1] * 64], jnp.int32)
+    # A sliding-window mask as the bias (the ModernBERT use case).
+    dist = np.abs(np.arange(s)[:, None] - np.arange(s)[None, :])
+    bias = jnp.asarray(np.where(dist <= 8, 0.0, -1e9), jnp.float32)
+    got = encoder_attention(
+        q, k, v, mask, num_heads=3, bias=bias, interpret=True
+    )
+    want = encoder_attention_reference(q, k, v, mask, num_heads=3, bias=bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    # Zero bias must reduce to the no-bias kernel exactly.
+    zero = encoder_attention(
+        q, k, v, mask, num_heads=3, bias=jnp.zeros((s, s)), interpret=True
+    )
+    plain = encoder_attention(q, k, v, mask, num_heads=3, interpret=True)
+    np.testing.assert_allclose(np.asarray(zero), np.asarray(plain), atol=2e-5)
+
+
+def test_modernbert_apply_pallas_path_matches_xla(rng):
+    """modernbert.apply(attn_impl='pallas') == 'xla': exercises the
+    window-bias select for both global (layer 0) and local layers."""
+    import distllm_tpu.ops.encoder_attention as ea
+    from distllm_tpu.models import modernbert
+
+    cfg = modernbert.ModernBertConfig(
+        vocab_size=128, hidden_size=48, num_layers=3, num_heads=3,
+        intermediate_size=96, max_position_embeddings=64,
+        global_attn_every_n_layers=2, local_attention=16, dtype='float32',
+    )
+    params = modernbert.init(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(rng.integers(0, 128, size=(2, 32)), jnp.int32)
+    mask = jnp.asarray([[1] * 32, [1] * 20 + [0] * 12], jnp.int32)
+
+    orig = ea.encoder_attention
+    try:
+        ea.encoder_attention = lambda *a, **kw: orig(
+            *a, **{**kw, 'interpret': True}
+        )
+        got = modernbert.apply(params, cfg, ids, mask, attn_impl='pallas')
+    finally:
+        ea.encoder_attention = orig
+    want = modernbert.apply(params, cfg, ids, mask, attn_impl='xla')
+    # Compare valid rows only: a padded query whose sliding window holds no
+    # valid key is fully masked, and the two backends emit different
+    # (equally meaningless) uniform-softmax garbage there; poolers mask
+    # those rows out downstream.
+    valid = np.asarray(mask, bool)
+    np.testing.assert_allclose(
+        np.asarray(got)[valid], np.asarray(want)[valid], atol=1e-4
+    )
+
+
 def test_bert_apply_pallas_path_matches_xla(rng):
     """bert.apply(attn_impl='pallas') == attn_impl='xla' (interpret via env
     is not available inside apply, so drive the kernel's own interpret mode
