@@ -35,6 +35,33 @@ import time
 # ----------------------------------------------------------------- stages
 
 
+def _workload_fingerprint(payload) -> str:
+    """Stable 12-hex digest of a stage's full workload (prompts + params).
+
+    Recorded in the bench JSON so any two runs claiming the same metric can
+    be checked for actually measuring the same thing (round 2 vs round 3
+    reported 795 vs 605 tok/s on what turned out to be different prompt
+    sets — this makes such drift visible instead of mysterious).
+    """
+    import hashlib
+
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _cache_entries() -> int | None:
+    """Number of entries in the persistent compilation cache (None if the
+    cache dir doesn't exist). before/after deltas reveal whether warmup
+    compiles HIT the AOT-preflight-seeded cache or re-lowered everything."""
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), '.jax_cache'
+    )
+    try:
+        return len(os.listdir(cache_dir))
+    except OSError:
+        return None
+
+
 def _stage_embed(quantization: str | None = None, prefix: str = '') -> dict:
     """Embed pipeline hot loop: bucketed tokenize -> jitted bf16 BERT
     forward -> mean pool -> host copy. PubMedBERT dims
@@ -93,10 +120,16 @@ def _stage_embed(quantization: str | None = None, prefix: str = '') -> dict:
         texts.append(' '.join(rng.choice(vocab, size=n)))
 
     # Warmup compiles every bucket shape the sorted batches touch.
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
     compute_embeddings(texts, encoder, pooler, batch_size)
     jax.block_until_ready(encoder.params)
+    warmup_secs = time.perf_counter() - warmup_start
+    bucket_stats: dict = {}
     start = time.perf_counter()
-    out = compute_embeddings(texts, encoder, pooler, batch_size)
+    out = compute_embeddings(
+        texts, encoder, pooler, batch_size, stats=bucket_stats
+    )
     elapsed = time.perf_counter() - start
     assert out.shape == (len(texts), cfg.hidden_size)
     throughput = len(texts) / elapsed
@@ -119,6 +152,20 @@ def _stage_embed(quantization: str | None = None, prefix: str = '') -> dict:
         f'{prefix}vs_baseline': round(throughput / a100_estimate, 3),
         f'{prefix}mfu': round(mfu, 3) if mfu is not None else None,
         f'{prefix}device': str(jax.devices()[0].device_kind),
+        f'{prefix}workload': _workload_fingerprint(
+            {'texts': texts, 'batch_size': batch_size,
+             'dims': cfg.model_dump() if hasattr(cfg, 'model_dump') else str(cfg)}
+        ),
+        f'{prefix}warmup_secs': round(warmup_secs, 1),
+        f'{prefix}cache_entries_before': cache_before,
+        f'{prefix}cache_entries_after': _cache_entries(),
+        f'{prefix}padding_frac': round(
+            1 - bucket_stats['tokens_real'] / bucket_stats['tokens_padded'], 3
+        ),
+        f'{prefix}bucket_batches': {
+            str(k): v
+            for k, v in sorted(bucket_stats['bucket_batches'].items())
+        },
     }
     if quantization:
         out[f'{prefix}quantization'] = quantization
@@ -206,6 +253,8 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
     backends = ['xla'] if jax.default_backend() == 'cpu' else ['pallas', 'xla']
     engine = None
     fallback_reason = None
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
     for backend in backends:
         engine_cfg.attn_backend = backend
         # Fresh params per candidate: the engine owns (and may delete)
@@ -234,6 +283,7 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
             if backend == backends[-1]:
                 raise
     assert engine is not None
+    warmup_secs = time.perf_counter() - warmup_start
 
     start = time.perf_counter()
     outs = engine.generate_ids(prompts, sampling)
@@ -267,6 +317,18 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
         f'{prefix}batch': max_num_seqs,
         f'{prefix}decode_steps': engine_cfg.decode_steps,
         f'{prefix}scheduler_impl': type(engine.sched).__name__,
+        f'{prefix}workload': _workload_fingerprint(
+            {'prompts': [list(map(int, p)) for p in prompts],
+             'sampling': sampling.__dict__,
+             'engine': {'block_size': engine_cfg.block_size,
+                        'num_blocks': num_blocks,
+                        'max_num_seqs': max_num_seqs,
+                        'decode_steps': engine_cfg.decode_steps},
+             'gen_tokens': gen_tokens}
+        ),
+        f'{prefix}warmup_secs': round(warmup_secs, 1),
+        f'{prefix}cache_entries_before': cache_before,
+        f'{prefix}cache_entries_after': _cache_entries(),
     }
     if quantization:
         out[f'{prefix}quantization'] = quantization

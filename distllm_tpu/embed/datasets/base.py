@@ -8,7 +8,7 @@ bucketed fixed shapes (TPU recompile discipline).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Protocol, runtime_checkable
 

@@ -35,6 +35,7 @@ def compute_embeddings(
     normalize: bool = False,
     flush_every: int = 64,
     max_resident_groups: int = 8,
+    stats: dict | None = None,
 ) -> np.ndarray:
     """Embed ``texts`` → host ``[N, H]`` float32 array in original order.
 
@@ -47,6 +48,11 @@ def compute_embeddings(
     sealed groups stay on device: past that the oldest (whose async copy has
     had the longest to land) is drained into the host buffer, so device
     residency stays O(flush_every · batch · H) rather than O(corpus).
+
+    ``stats``, when given, is filled with bucket-occupancy telemetry:
+    ``tokens_real`` / ``tokens_padded`` (device token slots incl. padding)
+    and ``bucket_batches`` (batches dispatched per bucket length) — the
+    numbers that say whether the bucket ladder is wasting MXU cycles.
     """
     n = len(texts)
     out = np.empty((n, encoder.embedding_size), dtype=np.float32)
@@ -95,6 +101,16 @@ def compute_embeddings(
         idx = order[lo : lo + batch_size]
         batch = encoder.tokenizer([texts[i] for i in idx])
         batch = batch.pad_batch_to(batch_size, pad_id=encoder.tokenizer.pad_id)
+        if stats is not None:
+            stats['tokens_real'] = stats.get('tokens_real', 0) + int(
+                batch.attention_mask.sum()
+            )
+            stats['tokens_padded'] = (
+                stats.get('tokens_padded', 0) + batch.input_ids.size
+            )
+            hist = stats.setdefault('bucket_batches', {})
+            bucket = int(batch.input_ids.shape[1])
+            hist[bucket] = hist.get(bucket, 0) + 1
         if fused is not None:
             pooled = fused(batch)
         else:

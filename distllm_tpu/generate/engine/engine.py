@@ -45,7 +45,6 @@ from distllm_tpu.generate.engine.scheduler import (
 )
 from distllm_tpu.models import mistral
 from distllm_tpu.models.tokenizer import bucket_ladder, pick_bucket
-from distllm_tpu.ops.paged_attention import write_prefill_kv
 from distllm_tpu.ops.sampling import sample_tokens
 from distllm_tpu.utils import BaseConfig
 

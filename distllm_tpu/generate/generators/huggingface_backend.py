@@ -12,8 +12,6 @@ from __future__ import annotations
 
 from typing import Literal
 
-from pydantic import Field
-
 from distllm_tpu.utils import BaseConfig, batch_data
 
 

@@ -8,7 +8,8 @@ weak #4: device MFU 0.43 vs padded tokens)."""
 
 from __future__ import annotations
 
-import sys as _sys, pathlib as _pl
+import pathlib as _pl
+import sys as _sys
 _sys.path.insert(0, str(_pl.Path(__file__).resolve().parent.parent))
 
 from distllm_tpu.utils import apply_platform_env
@@ -61,7 +62,7 @@ def main() -> None:
           f'({(t_full-t_stub)/t_full:.1%} of forward)')
 
     try:
-        from distllm_tpu.ops.encoder_attention import encoder_attention
+        from distllm_tpu.ops.encoder_attention import encoder_attention  # noqa: F401
 
         common.sdpa = None  # ensure unused
         fast = jax.jit(

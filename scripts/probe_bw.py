@@ -12,8 +12,6 @@ from distllm_tpu.utils import apply_platform_env
 
 apply_platform_env()
 
-import annotations
-
 import time
 
 import jax

@@ -15,7 +15,8 @@ between the measured window time and the ~283 ms weight-streaming floor
 
 from __future__ import annotations
 
-import sys as _sys, pathlib as _pl
+import pathlib as _pl
+import sys as _sys
 _sys.path.insert(0, str(_pl.Path(__file__).resolve().parent.parent))
 
 from distllm_tpu.utils import apply_platform_env

@@ -6,7 +6,8 @@ transfer per dispatch, or (c) the fused pooling epilogue."""
 
 from __future__ import annotations
 
-import sys as _sys, pathlib as _pl
+import pathlib as _pl
+import sys as _sys
 _sys.path.insert(0, str(_pl.Path(__file__).resolve().parent.parent))
 
 from distllm_tpu.utils import apply_platform_env

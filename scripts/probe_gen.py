@@ -12,7 +12,8 @@ keep the instrumentation itself tested.
 from __future__ import annotations
 
 import os
-import sys as _sys, pathlib as _pl
+import pathlib as _pl
+import sys as _sys
 _sys.path.insert(0, str(_pl.Path(__file__).resolve().parent.parent))
 
 import time

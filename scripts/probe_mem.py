@@ -2,7 +2,8 @@
 
 from __future__ import annotations
 
-import sys as _sys, pathlib as _pl
+import pathlib as _pl
+import sys as _sys
 _sys.path.insert(0, str(_pl.Path(__file__).resolve().parent.parent))
 
 from distllm_tpu.utils import apply_platform_env
@@ -13,7 +14,6 @@ import argparse
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from distllm_tpu.models import mistral
 

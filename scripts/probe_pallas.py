@@ -3,7 +3,8 @@ correctness vs the XLA path, then a timing comparison at bench shapes."""
 
 from __future__ import annotations
 
-import sys as _sys, pathlib as _pl
+import pathlib as _pl
+import sys as _sys
 _sys.path.insert(0, str(_pl.Path(__file__).resolve().parent.parent))
 
 from distllm_tpu.utils import apply_platform_env

@@ -2,7 +2,6 @@
 
 import json
 
-import numpy as np
 import pytest
 
 from distllm_tpu.generate import get_generator

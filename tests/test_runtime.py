@@ -4,7 +4,6 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from distllm_tpu.models.loader import (
@@ -18,7 +17,7 @@ from distllm_tpu.models.tokenizer import (
     bucket_ladder,
     pick_bucket,
 )
-from distllm_tpu.parallel import make_mesh, named_sharding, shard_pytree
+from distllm_tpu.parallel import make_mesh, shard_pytree
 from distllm_tpu.parallel.mesh import MeshSpec
 
 

@@ -3,7 +3,6 @@
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from distllm_tpu.ops.topk import hamming_topk, pack_sign_bits, topk_inner_product
@@ -186,7 +185,6 @@ def test_index_sharded_build_and_reload(tmp_path, rng):
     serves identical results without rebuilding."""
     from datasets import Dataset
 
-    from distllm_tpu.rag import search as search_mod
     from distllm_tpu.rag.search import TpuIndexV2, TpuIndexV2Config
 
     n, h = 50, 16
