@@ -32,7 +32,13 @@ from pydantic import Field
 
 from distllm_tpu.embed.encoders.base import Encoder
 from distllm_tpu.embed.poolers.base import Pooler
-from distllm_tpu.ops.topk import hamming_topk, pack_sign_bits, topk_inner_product
+from distllm_tpu.ops.topk import (
+    hamming_topk,
+    int8_topk,
+    pack_sign_bits,
+    quantize_int8_rows,
+    topk_inner_product,
+)
 from distllm_tpu.utils import BaseConfig
 
 
@@ -77,10 +83,10 @@ class TpuIndexV2Config(BaseConfig):
         description="'flat' (exact) — 'hnsw*' names accepted and served "
         'exactly (TPU brute force beats CPU graphs).',
     )
-    precision: Literal['float32', 'ubinary'] = 'float32'
+    precision: Literal['float32', 'int8', 'ubinary'] = 'float32'
     rescore_multiplier: int = Field(
         default=4,
-        description='ubinary: oversample factor before fp32 rescoring.',
+        description='int8/ubinary: oversample factor before fp32 rescoring.',
     )
     metric: Literal['inner_product'] = 'inner_product'
     normalize: bool = Field(
@@ -144,6 +150,11 @@ class TpuIndexV2:
             rows = self._chunk(offsets[part])
             if self.config.precision == 'ubinary':
                 rows = pack_sign_bits(rows)
+            elif self.config.precision == 'int8':
+                codes, scales = quantize_int8_rows(rows)
+                name = f'{self._index_file.stem}.part{part:05d}.npz'
+                np.savez(shard_dir / name, codes=codes, scales=scales)
+                return name
             name = f'{self._index_file.stem}.part{part:05d}.npy'
             np.save(shard_dir / name, rows)
             return name
@@ -186,28 +197,35 @@ class TpuIndexV2:
                 np.concatenate([np.asarray(c) for c in self._iter_stored_chunks()])
             )
             self._corpus = None
+            self._int8 = None
+            return
+
+        if self.config.precision == 'int8':
+            # corpus/4 bytes on device (codes) + tiny scales: the middle
+            # tier — MXU int8 scoring with fp32 rescore (same rescore path
+            # as ubinary). Beyond-reference extension: the reference
+            # validates only float32/ubinary (search.py:172-176).
+            parts = list(self._iter_stored_chunks())
+            codes = np.concatenate([np.asarray(p['codes']) for p in parts])
+            scales = np.concatenate([np.asarray(p['scales']) for p in parts])
+            if self.mesh is not None and self.mesh.shape.get('data', 1) > 1:
+                self._int8 = self._put_row_sharded((codes, 0), (scales, 1))
+            else:
+                self._int8 = (jnp.asarray(codes), jnp.asarray(scales))
+            self._packed = None
+            self._corpus = None
             return
 
         self._packed = None
+        self._int8 = None
         if self.mesh is not None and self.mesh.shape.get('data', 1) > 1:
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
             # Multi-chip: assemble on host (pod hosts have the RAM), pad to
             # a shardable row count — padded indices (>= _num_real) are
             # dropped in the search filter.
             embeddings = np.concatenate(
                 [np.asarray(c) for c in self._iter_stored_chunks()]
             )
-            shards = self.mesh.shape['data']
-            pad = (-embeddings.shape[0]) % shards
-            if pad:
-                embeddings = np.concatenate(
-                    [embeddings, np.zeros((pad, embeddings.shape[1]), embeddings.dtype)]
-                )
-            self._corpus = jax.device_put(
-                embeddings, NamedSharding(self.mesh, P('data', None))
-            )
+            (self._corpus,) = self._put_row_sharded((embeddings, 0))
             return
 
         # Single device: assemble directly in HBM chunk by chunk via a
@@ -231,6 +249,25 @@ class TpuIndexV2:
             lo += part.shape[0]
         self._corpus = buf
 
+    def _put_row_sharded(self, *arrays_with_fill) -> tuple:
+        """Pad each host array to a row count divisible by the mesh's
+        ``data`` axis (with the given fill value) and device_put it
+        row-sharded. One home for the pad+shard math of every precision
+        tier; padded indices (>= ``_num_real``) are dropped downstream."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shards = self.mesh.shape['data']
+        out = []
+        for arr, fill in arrays_with_fill:
+            pad = (-arr.shape[0]) % shards
+            if pad:
+                block = np.full((pad, *arr.shape[1:]), fill, arr.dtype)
+                arr = np.concatenate([arr, block])
+            spec = P('data', *([None] * (arr.ndim - 1)))
+            out.append(jax.device_put(arr, NamedSharding(self.mesh, spec)))
+        return tuple(out)
+
     def __len__(self) -> int:
         return len(self.dataset)
 
@@ -243,6 +280,8 @@ class TpuIndexV2:
     ) -> BatchedSearchResults:
         if self.config.precision == 'ubinary':
             scores, indices = self._search_ubinary(query_embeddings, top_k)
+        elif self.config.precision == 'int8':
+            scores, indices = self._search_int8(query_embeddings, top_k)
         else:
             scores, indices = topk_inner_product(
                 jnp.asarray(query_embeddings), self._corpus, top_k, self.mesh
@@ -263,13 +302,34 @@ class TpuIndexV2:
             top_k * self.config.rescore_multiplier, len(self.dataset)
         )
         _, cand = hamming_topk(query_bits, self._packed, oversample)
-        cand = np.asarray(cand)
-        # fp32 rescore of the binary candidates against the full-precision
-        # query (sentence-transformers rescore semantics). Candidate
-        # vectors come from the arrow-mmap'd dataset per batch — the index
-        # keeps NO fp32 corpus copy (that second copy doubled host RSS in
-        # earlier revisions).
-        flat = cand.reshape(-1)
+        return self._rescore(queries, np.asarray(cand), top_k)
+
+    def _search_int8(self, queries: np.ndarray, top_k: int):
+        oversample = min(
+            top_k * self.config.rescore_multiplier, len(self.dataset)
+        )
+        codes, scales = self._int8
+        _, cand = int8_topk(
+            jnp.asarray(queries.astype(np.float32)), codes, scales,
+            oversample, self.mesh,
+        )
+        return self._rescore(queries, np.asarray(cand), top_k)
+
+    def _rescore(self, queries: np.ndarray, cand: np.ndarray, top_k: int):
+        """fp32 rescore of quantized-tier candidates against the
+        full-precision query (sentence-transformers rescore semantics).
+        Candidate vectors come from the arrow-mmap'd dataset per batch —
+        the index keeps NO fp32 corpus copy (that second copy doubled host
+        RSS in earlier revisions).
+
+        ``cand`` may contain padded-row indices (>= ``_num_real``) from a
+        sharded layout; their ORIGINAL indices are preserved (so the
+        ``search()`` filter drops them) while the dataset gather uses a
+        clamped copy and their rescores are pinned to -inf so they can
+        never displace a real neighbor in the top-k.
+        """
+        valid = cand < self._num_real
+        flat = np.minimum(cand, self._num_real - 1).reshape(-1)
         order_back = np.argsort(np.argsort(flat))
         gathered = np.asarray(
             self.dataset[np.sort(flat).tolist()]['embeddings'],
@@ -280,6 +340,7 @@ class TpuIndexV2:
             norms = np.linalg.norm(cand_vectors, axis=-1, keepdims=True)
             cand_vectors = cand_vectors / np.clip(norms, 1e-12, None)
         rescored = np.einsum('bh,boh->bo', queries.astype(np.float32), cand_vectors)
+        rescored = np.where(valid, rescored, -np.inf)
         order = np.argsort(-rescored, axis=1)[:, :top_k]
         indices = np.take_along_axis(cand, order, axis=1)
         scores = np.take_along_axis(rescored, order, axis=1)

@@ -39,7 +39,9 @@ import numpy as np  # noqa: E402
 
 from distllm_tpu.ops.topk import (  # noqa: E402
     hamming_topk,
+    int8_topk,
     pack_sign_bits,
+    quantize_int8_rows,
     topk_inner_product,
 )
 
@@ -174,34 +176,29 @@ def bench_ubinary(rows: int, dim: int, n_queries: int, top_k: int,
         del chunk
     corpus_mm.flush()
     packed = np.concatenate(packed_parts)
+    packed_parts.clear()
     build_secs = time.perf_counter() - t_build
     _emit(tier='ubinary_build', rows=rows, dim=dim,
           packed_gib=round(packed.nbytes / 2**30, 3),
           build_secs=round(build_secs, 1))
 
-    try:
-        corpus_bits = jax.device_put(packed)
-        query_bits = jnp.asarray(pack_sign_bits(queries))
-        oversample = top_k * rescore_multiplier
+    oversample = top_k * rescore_multiplier
+    # The exact nearest neighbor per query (= the planted source): the
+    # meaningful quality target. The other top-k ground-truth rows of a
+    # synthetic corpus are random near-ties no quantizer can rank, so the
+    # overlap recall@k is reported but top1_hit is the headline.
+    gt_top1 = np.take_along_axis(
+        gt_idx, np.argmax(gt_scores, axis=1, keepdims=True), axis=1
+    )[:, 0]
 
-        # warmup
-        d, c = hamming_topk(query_bits, corpus_bits, oversample)
-        _sync((d, c))
-        # The exact nearest neighbor per query (= the planted source):
-        # the meaningful quality target. The other 9 ground-truth rows of a
-        # synthetic corpus are random near-ties no quantizer can rank, so
-        # the overlap recall@k is reported but top1_hit is the headline.
-        gt_top1 = np.take_along_axis(
-            gt_idx, np.argmax(gt_scores, axis=1, keepdims=True), axis=1
-        )[:, 0]
-        times = []
-        hamming_times = []
-        recall = None
-        top1_hit = None
+    def measure(tier: str, cand_fn, extra: dict) -> None:
+        cand = cand_fn()  # warmup compile
+        _sync(cand)
+        times, scan_times = [], []
+        recall = top1_hit = None
         for _ in range(trials):
             t0 = time.perf_counter()
-            _, cand = hamming_topk(query_bits, corpus_bits, oversample)
-            cand = np.asarray(cand)
+            cand = np.asarray(cand_fn())
             t1 = time.perf_counter()
             # Gather candidates from the disk memmap exactly the way the
             # production path gathers from the arrow mmap (sorted access).
@@ -213,25 +210,62 @@ def bench_ubinary(rows: int, dim: int, n_queries: int, top_k: int,
             order = np.argsort(-rescored, axis=1)[:, :top_k]
             got_idx = np.take_along_axis(cand, order, axis=1)
             times.append(time.perf_counter() - t0)
-            hamming_times.append(t1 - t0)
+            scan_times.append(t1 - t0)
             hits = sum(
                 len(set(map(int, got_idx[b])) & set(map(int, gt_idx[b])))
                 for b in range(len(queries))
             )
             recall = hits / (len(queries) * top_k)
             top1_hit = float(
-                np.mean([gt_top1[b] in got_idx[b] for b in range(len(queries))])
+                np.mean(
+                    [gt_top1[b] in got_idx[b] for b in range(len(queries))]
+                )
             )
         best = min(times)
         _emit(
-            tier='ubinary_rescore', rows=rows, dim=dim, batch=n_queries,
+            tier=tier, rows=rows, dim=dim, batch=n_queries,
             top_k=top_k, oversample=oversample,
             latency_ms=round(best * 1e3, 1),
-            hamming_ms=round(min(hamming_times) * 1e3, 1),
+            scan_ms=round(min(scan_times) * 1e3, 1),
             queries_per_s=round(n_queries / best, 1),
             recall_at_k=round(recall, 4),
             top1_hit=round(top1_hit, 4),
             platform=jax.default_backend(),
+            **extra,
+        )
+
+    try:
+        corpus_bits = jax.device_put(packed)
+        query_bits = jnp.asarray(pack_sign_bits(queries))
+        measure(
+            'ubinary_rescore',
+            lambda: hamming_topk(query_bits, corpus_bits, oversample)[1],
+            {'packed_gib': round(packed.nbytes / 2**30, 3)},
+        )
+        del corpus_bits
+
+        # int8 tier: quantize from the memmap AFTER the ubinary phase so
+        # codes (~corpus/4 bytes) never coexist with it in host RAM, and
+        # its build cost is timed on its own, not inside 'ubinary_build'.
+        t_q = time.perf_counter()
+        code_host = np.empty((rows, dim), np.int8)
+        scale_host = np.empty((rows,), np.float32)
+        for lo in range(0, rows, CHUNK):
+            hi = min(lo + CHUNK, rows)
+            code_host[lo:hi], scale_host[lo:hi] = quantize_int8_rows(
+                np.asarray(corpus_mm[lo:hi])
+            )
+        int8_build_secs = time.perf_counter() - t_q
+        codes = jax.device_put(code_host)
+        scales = jax.device_put(scale_host)
+        codes_gib = round(code_host.nbytes / 2**30, 3)
+        del code_host, scale_host
+        queries_dev = jnp.asarray(queries)
+        measure(
+            'int8_rescore',
+            lambda: int8_topk(queries_dev, codes, scales, oversample)[1],
+            {'codes_gib': codes_gib,
+             'build_secs': round(int8_build_secs, 1)},
         )
     finally:
         del corpus_mm

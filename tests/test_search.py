@@ -109,6 +109,101 @@ def test_index_ubinary_rescore(embeddings_dataset):
         assert row[0] == qi  # self-match survives quantization + rescore
 
 
+def test_index_int8_rescore(embeddings_dataset):
+    from distllm_tpu.rag.search import TpuIndexV2, TpuIndexV2Config
+
+    dataset_dir, embeddings = embeddings_dataset
+    index = TpuIndexV2(
+        TpuIndexV2Config(
+            dataset_dir=dataset_dir, precision='int8', rescore_multiplier=4
+        )
+    )
+    normalized = embeddings / np.linalg.norm(embeddings, axis=1, keepdims=True)
+    results = index.search(normalized[:4], top_k=3, score_threshold=-10.0)
+    for qi, row in enumerate(results.total_indices):
+        assert row[0] == qi  # self-match survives int8 quantization
+    # int8 scoring error is small; after fp32 rescore the ranking should
+    # match the exact index on these shapes.
+    exact = TpuIndexV2(
+        TpuIndexV2Config(dataset_dir=dataset_dir)
+    ).search(normalized[:4], top_k=3, score_threshold=-10.0)
+    assert results.total_indices == exact.total_indices
+
+
+def test_int8_topk_matches_exact(rng):
+    from distllm_tpu.ops.topk import int8_topk, quantize_int8_rows
+
+    corpus = rng.normal(size=(200, 64)).astype(np.float32)
+    corpus /= np.linalg.norm(corpus, axis=1, keepdims=True)
+    queries = corpus[:5] + 0.01 * rng.normal(size=(5, 64)).astype(np.float32)
+    codes, scales = quantize_int8_rows(corpus)
+    # Codes round-trip near the original.
+    recon = codes.astype(np.float32) * scales[:, None]
+    assert np.abs(recon - corpus).max() < 0.01
+    scores, idx = int8_topk(
+        jnp.asarray(queries), jnp.asarray(codes), jnp.asarray(scales), 3
+    )
+    exact = queries @ corpus.T
+    exact_top1 = np.argmax(exact, axis=1)
+    assert list(np.asarray(idx)[:, 0]) == list(exact_top1)
+    # Approximate scores are close to the exact inner products.
+    got = np.asarray(scores)[:, 0]
+    want = np.max(exact, axis=1)
+    np.testing.assert_allclose(got, want, atol=0.05)
+
+
+def test_index_int8_sharded_mesh_matches_single(embeddings_dataset):
+    from distllm_tpu.rag.search import TpuIndexV2Config
+
+    dataset_dir, embeddings = embeddings_dataset
+    single = TpuIndexV2Config(
+        dataset_dir=dataset_dir, precision='int8'
+    ).get_index()
+    sharded = TpuIndexV2Config(
+        dataset_dir=dataset_dir, precision='int8', mesh={'data': -1, 'model': 1}
+    ).get_index()
+    normalized = embeddings / np.linalg.norm(embeddings, axis=1, keepdims=True)
+    r1 = single.search(normalized[:3], top_k=5, score_threshold=-10.0)
+    r2 = sharded.search(normalized[:3], top_k=5, score_threshold=-10.0)
+    assert r1.total_indices == r2.total_indices
+
+
+def test_index_int8_sharded_padding_no_duplicates(tmp_path, rng):
+    """Corpus size NOT divisible by the mesh (61 rows on 8 devices pads to
+    64): padded candidates must be filtered, never clamped onto a real row
+    — a clamp returns the last real row repeatedly, crowding true
+    neighbors out of the top-k."""
+    from datasets import Dataset
+
+    from distllm_tpu.rag.search import TpuIndexV2Config
+
+    n = 61
+    emb = rng.normal(size=(n, 32)).astype(np.float32)
+    Dataset.from_dict(
+        {'embeddings': [e for e in emb], 'text': [str(i) for i in range(n)]}
+    ).save_to_disk(str(tmp_path / 'ds'))
+    normalized = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    sharded = TpuIndexV2Config(
+        dataset_dir=tmp_path / 'ds', precision='int8',
+        mesh={'data': -1, 'model': 1},
+    ).get_index()
+    # Query the LAST real row: with clamping, padded candidates would
+    # collapse onto index n-1 and duplicate it.
+    results = sharded.search(normalized[n - 1 :], top_k=5,
+                             score_threshold=-10.0)
+    row = results.total_indices[0]
+    assert row[0] == n - 1
+    assert len(row) == len(set(row)), f'duplicate indices: {row}'
+    assert all(i < n for i in row)
+    single = TpuIndexV2Config(
+        dataset_dir=tmp_path / 'ds', precision='int8'
+    ).get_index()
+    assert (
+        single.search(normalized[n - 1 :], top_k=5, score_threshold=-10.0)
+        .total_indices[0] == row
+    )
+
+
 def test_index_sharded_mesh_matches_single(embeddings_dataset):
     """Config-driven mesh sharding returns identical results (odd N pads)."""
     from distllm_tpu.rag.search import TpuIndexV2Config
