@@ -118,6 +118,14 @@ class EngineConfig(BaseConfig):
     # parity: vLLM's top_k is off by default); serving deployments that
     # want the fast path set 64 explicitly (bench.py does).
     sampling_top_window: int = 0
+    # Unroll the layer scan inside decode dispatches. Decode is weight-
+    # bandwidth bound and the rolled scan's dynamic-slice of stacked MLP
+    # kernels is materialized by XLA (~3x HBM traffic on most of the
+    # weights — AOT HLO census, scripts/probe_decode_hlo.py); unrolling
+    # folds the slices into the matmuls. Costs one longer compile per
+    # decode shape (amortized by the persistent cache); prefill keeps the
+    # rolled scan either way.
+    decode_layer_unroll: bool = True
 
     @field_validator('sampling_top_window')
     @classmethod
@@ -257,6 +265,7 @@ class LLMEngine:
                 temp, top_p, min_p, key, num_steps=num_steps,
                 attn_backend=attn_backend, max_table_positions=max_tables,
                 sampling_top_window=cfg.sampling_top_window,
+                layer_unroll=cfg.decode_layer_unroll,
             )
 
         self._decode_window = jax.jit(window_fn, donate_argnums=(4, 5))

@@ -278,9 +278,21 @@ def _decode_core(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     attn_backend: str,
+    layer_unroll: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step's compute, RoPE tables passed in (so a multi-step
-    scan hoists them out of the loop)."""
+    scan hoists them out of the loop).
+
+    ``layer_unroll=True`` unrolls the layer scan. Decode is weight-
+    bandwidth bound, and the rolled scan's per-iteration dynamic-slice of
+    the stacked MLP kernels is MATERIALIZED by XLA as a ~0.35 GB/layer
+    temp (read slab + write temp + read temp ≈ 3x traffic on 78% of the
+    weights — found via AOT HLO census, scripts/probe_decode_hlo.py,
+    matching the measured ~3x gap to the weight-streaming roofline in
+    BENCH_NOTES_r03.md). Unrolling turns those into static slices that
+    fold into the matmuls. Prefill keeps the rolled scan: compute-bound,
+    and the slice traffic amortizes over the whole token batch.
+    """
     from distllm_tpu.ops.paged_attention import (
         paged_attention_pallas,
         paged_attention_xla,
@@ -306,11 +318,16 @@ def _decode_core(
     x = jnp.asarray(params['embed'])[input_ids].astype(dtype)  # [B, H]
 
     # The FULL caches ride the scan carry and each layer dynamic-update-
-    # slices its own [num_blocks, bs, Nkv, Hd] plane in place — XLA aliases
-    # while-loop carries, so no second cache copy is ever materialized.
-    # (Scanning the caches as xs/ys instead allocates a full stacked output
-    # buffer: +1 GB at 7B dims, and one more when a multi-step window scan
-    # wraps this — that overflowed the v5e's 16 GB HBM.)
+    # slices its own [num_blocks, bs, Nkv, Hd] plane in place. Rolled
+    # (layer_unroll=False): XLA aliases while-loop carries, so no second
+    # cache copy is ever materialized. Unrolled: the same DUS chain sits in
+    # straight-line code, where in-place updates rely on XLA's buffer
+    # reuse instead of carry aliasing — tests/test_aot_tpu.py asserts the
+    # unrolled window's temp budget stays cache-copy-free so a missed
+    # reuse cannot land silently. (Scanning the caches as xs/ys instead
+    # allocates a full stacked output buffer: +1 GB at 7B dims, and one
+    # more when a multi-step window scan wraps this — that overflowed the
+    # v5e's 16 GB HBM.)
     def layer(carry, xs):
         x, k_cache, v_cache = carry
         lp, li = xs
@@ -350,6 +367,7 @@ def _decode_core(
         layer,
         (x, k_cache, v_cache),
         (params['layers'], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+        unroll=cfg.num_layers if layer_unroll else 1,
     )
     hidden = common.rms_norm(x, params['final_ln']['scale'], cfg.rms_norm_eps)
     return logits(params, cfg, hidden), k_cache, v_cache
@@ -365,6 +383,7 @@ def decode_step(
     block_tables: jnp.ndarray,  # [B, max_blocks]
     context_lens: jnp.ndarray,  # [B] valid tokens incl. the new one
     attn_backend: str = 'xla',
+    layer_unroll: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Single-token decode over the paged KV cache.
 
@@ -378,7 +397,7 @@ def decode_step(
     cos, sin = _rope_tables(cfg, cfg.max_position_embeddings)
     return _decode_core(
         params, cfg, input_ids, positions, k_cache, v_cache, block_tables,
-        context_lens, cos, sin, attn_backend,
+        context_lens, cos, sin, attn_backend, layer_unroll,
     )
 
 
@@ -400,6 +419,7 @@ def decode_loop(
     attn_backend: str = 'xla',
     max_table_positions: int | None = None,
     sampling_top_window: int = 0,
+    layer_unroll: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """``num_steps`` fused decode+sample steps in ONE dispatch.
 
@@ -435,7 +455,7 @@ def decode_loop(
         bt_eff = jnp.where(live[:, None], block_tables, 0)
         logits_, k_cache, v_cache = _decode_core(
             params, cfg, ids, pos, k_cache, v_cache, bt_eff, ctx,
-            cos, sin, attn_backend,
+            cos, sin, attn_backend, layer_unroll,
         )
         token = sample_tokens(
             logits_, step_key, temperature, top_p, min_p,

@@ -60,6 +60,11 @@ def window_args(params_tree, B, nb, R):
         sds((2,), jnp.uint32),
     )
 
+# Match the engine's decode_layer_unroll so the seeded cache keys hit at
+# serve time; export DISTLLM_PREFLIGHT_LAYER_UNROLL=0 when serving with
+# decode_layer_unroll=False (the escape hatch from the longer compile).
+_LAYER_UNROLL = os.environ.get('DISTLLM_PREFLIGHT_LAYER_UNROLL', '1') != '0'
+
 failures: list[str] = []
 
 
@@ -70,7 +75,7 @@ def compile_window(params_tree, B, nb, R, backend, label):
             mistral.decode_loop(
                 p, mcfg, i, po, k, v, bt, c, sl, tmp, tp, mp, ky,
                 num_steps=16, attn_backend=backend, max_table_positions=512,
-                sampling_top_window=64)
+                sampling_top_window=64, layer_unroll=_LAYER_UNROLL)
         jitted = jax.jit(fn, donate_argnums=(4, 5),
                          in_shardings=(Format(Layout.AUTO),) + (Format(),) * 11)
         compiled = jitted.lower(*window_args(params_tree, B, nb, R)).compile()
@@ -145,7 +150,7 @@ def compile_multichip() -> None:
                 mistral.decode_loop(
                     p, mcfg, i, po, k, v, bt, c, sl, tmp, tp_, mp, ky,
                     num_steps=4, attn_backend='xla', max_table_positions=512,
-                    sampling_top_window=64),
+                    sampling_top_window=64, layer_unroll=_LAYER_UNROLL),
             donate_argnums=(4, 5),
         ).lower(
             tp_params, r((B,), jnp.int32), r((B,), jnp.int32),

@@ -54,6 +54,11 @@ def test_encoder_attention_compiles_for_tpu(v5e):
 
 @pytest.mark.parametrize('backend', ['pallas', 'xla'])
 def test_decode_window_compiles_for_tpu(v5e, backend):
+    """Both scan variants must lower: rolled, and the engine-default
+    unrolled graph (whose straight-line cache updates depend on XLA
+    buffer reuse rather than while-carry aliasing). A missed reuse in the
+    unrolled body would add full-cache-sized temps on top of the rolled
+    baseline — asserted against below."""
     from distllm_tpu.models import mistral
 
     # head_dim must be 128 (the Pallas kernel's DMA alignment contract).
@@ -67,22 +72,40 @@ def test_decode_window_compiles_for_tpu(v5e, backend):
     params = jax.tree.map(lambda x: v5e(x.shape, x.dtype), shapes)
     b, nb, bs, rows = 8, 64, 16, 16
     kshape = (cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_size)
-    jax.jit(
-        lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky:
-            mistral.decode_loop(
-                p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
-                num_steps=4, attn_backend=backend, max_table_positions=256,
-                sampling_top_window=16,
-            ),
-        donate_argnums=(4, 5),
-    ).lower(
-        params, v5e((b,), jnp.int32), v5e((b,), jnp.int32),
-        v5e((b,), jnp.int32), v5e(kshape, jnp.bfloat16),
-        v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
-        v5e((b,), jnp.int32), v5e((b,), jnp.float32),
-        v5e((b,), jnp.float32), v5e((b,), jnp.float32),
-        v5e((2,), jnp.uint32),
-    ).compile()
+    cache_bytes = 2 * int(np.prod(kshape)) * 2  # k + v, bf16
+    temps = {}
+    for layer_unroll in (False, True):
+        compiled = jax.jit(
+            lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky,
+                   un=layer_unroll:
+                mistral.decode_loop(
+                    p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                    num_steps=4, attn_backend=backend,
+                    max_table_positions=256,
+                    sampling_top_window=16, layer_unroll=un,
+                ),
+            donate_argnums=(4, 5),
+        ).lower(
+            params, v5e((b,), jnp.int32), v5e((b,), jnp.int32),
+            v5e((b,), jnp.int32), v5e(kshape, jnp.bfloat16),
+            v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
+            v5e((b,), jnp.int32), v5e((b,), jnp.float32),
+            v5e((b,), jnp.float32), v5e((b,), jnp.float32),
+            v5e((2,), jnp.uint32),
+        ).compile()
+        mem = compiled.memory_analysis()
+        temps[layer_unroll] = getattr(mem, 'temp_size_in_bytes', None)
+    if temps[True] is not None:
+        # Unrolling must not degrade in-place cache updates to copies:
+        # each missed reuse adds a full-cache-sized temp. (The rolled
+        # variant reports ~0 temps — memory_analysis does not descend
+        # into while bodies — so the bound is absolute, not relative:
+        # activation temps at these dims are ~2.5 MB, well under one
+        # 4 MB cache copy.)
+        assert temps[True] < cache_bytes, (
+            f'unrolled temps {temps[True]} vs one cache copy '
+            f'{cache_bytes} (rolled baseline: {temps[False]})'
+        )
 
 
 def test_int8_decode_window_compiles_for_tpu(v5e):
