@@ -11,6 +11,8 @@ between the measured window time and the ~283 ms weight-streaming floor
   the problem, not dispatch)
 - sampler: top-64 window vs exact full-vocab sort (the 32k bitonic sort
   per step is a prime suspect)
+- layer scan rolled vs unrolled at the serving window (the materialized
+  weight-slice hypothesis, scripts/probe_decode_hlo.py)
 """
 
 from __future__ import annotations
@@ -53,8 +55,6 @@ def main() -> None:
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
     kshape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads,
               cfg.head_size)
-    k_cache = jnp.zeros(kshape, jnp.bfloat16)
-    v_cache = jnp.zeros(kshape, jnp.bfloat16)
 
     rng = np.random.default_rng(0)
     ctx = 160  # mid-run context length
@@ -73,20 +73,32 @@ def main() -> None:
 
     weight_gb = 2 * n_params / 1e9
     print(f'batch={batch} ctx={ctx} weights={weight_gb:.1f} GB')
-    cases = [(be, ns, 64) for be in backends for ns in steps_list]
+    cases = [(be, ns, 64, False) for be in backends for ns in steps_list]
     # Sampler ablation: exact 32k sort at the serving window length.
-    cases.append((backends[0], steps_list[-1], 0))
-    for backend, num_steps, top_window in cases:
+    cases.append((backends[0], steps_list[-1], 0, False))
+    # The rolled-vs-unrolled A/B at the SERVING window length (16 — the
+    # shape behind the r3 845 ms measurement and the 283 ms floor; the
+    # materialized weight-slice hypothesis, scripts/probe_decode_hlo.py):
+    # unrolled should approach the floor if the slices were the gap.
+    serving_steps = 16 if 16 in steps_list else steps_list[-1]
+    for be in backends:
+        cases.append((be, serving_steps, 64, True))
+    for backend, num_steps, top_window, unroll in cases:
             fn = jax.jit(
                 lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky, ns=num_steps,
-                       be=backend, tw=top_window: mistral.decode_loop(
+                       be=backend, tw=top_window, un=unroll: mistral.decode_loop(
                     p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
                     num_steps=ns, attn_backend=be, max_table_positions=512,
-                    sampling_top_window=tw,
+                    sampling_top_window=tw, layer_unroll=un,
                 ),
                 donate_argnums=(4, 5),
             )
             steps_left = jnp.full((batch,), num_steps, jnp.int32)
+            # Fresh caches per case: donation deletes them on dispatch, so
+            # a mid-case failure (the flaky-chip scenario this probe
+            # exists for) must not cascade 'Array deleted' into the rest.
+            k_cache = jnp.zeros(kshape, jnp.bfloat16)
+            v_cache = jnp.zeros(kshape, jnp.bfloat16)
             try:
                 t0 = time.perf_counter()
                 out = fn(params, ids, positions, context_lens, k_cache,
@@ -111,14 +123,16 @@ def main() -> None:
                     np.asarray(t)
                 best = (time.perf_counter() - t0) / n_reps
                 floor = num_steps * 2 * n_params / 819e9
-                print(f'{backend:6s} steps={num_steps:2d} tw={top_window:2d}:'
+                print(f'{backend:6s} steps={num_steps:2d} tw={top_window:2d}'
+                      f' unroll={int(unroll)}:'
                       f' {best*1e3:7.1f} ms'
                       f' ({best/num_steps*1e3:6.2f} ms/step,'
                       f' {batch*num_steps/best:7.0f} tok/s,'
                       f' floor {floor*1e3:5.0f} ms, x{best/floor:4.1f})',
                       flush=True)
             except Exception as exc:
-                print(f'{backend:6s} steps={num_steps:2d} tw={top_window:2d}:'
+                print(f'{backend:6s} steps={num_steps:2d} tw={top_window:2d}'
+                      f' unroll={int(unroll)}:'
                       f' FAILED {repr(exc)[:200]}', flush=True)
 
 
