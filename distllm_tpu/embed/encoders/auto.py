@@ -25,6 +25,7 @@ _FAMILIES = {
     'bert': (bert.BertConfig, bert),
     'mistral': (mistral.MistralConfig, mistral),
     'llama': (mistral.MistralConfig, mistral),
+    'qwen2': (mistral.MistralConfig, mistral),  # + Q/K/V biases
     'mixtral': (mixtral.MixtralConfig, mixtral),
     'esm': (esm2.Esm2Config, esm2),
     'modernbert': (modernbert.ModernBertConfig, modernbert),

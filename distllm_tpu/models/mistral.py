@@ -1,4 +1,5 @@
-"""Mistral/Llama-family decoder (SFR-Embedding-Mistral, Mistral-7B-Instruct).
+"""Mistral/Llama/Qwen2-family decoder (SFR-Embedding-Mistral,
+Mistral-7B-Instruct; Qwen2 = same architecture + Q/K/V biases).
 
 One implementation serves both reference roles:
 
@@ -40,6 +41,9 @@ class MistralConfig(BaseConfig):
     rms_norm_eps: float = 1e-5
     sliding_window: int | None = None
     tie_word_embeddings: bool = False
+    # Qwen2-family checkpoints (same architecture + Q/K/V projection
+    # biases; HF Qwen2Model always has them, MistralModel never does).
+    attention_bias: bool = False
     dtype: str = 'bfloat16'
 
     @property
@@ -59,8 +63,18 @@ class MistralConfig(BaseConfig):
             max_position_embeddings=hf.get('max_position_embeddings', 32768),
             rope_theta=hf.get('rope_theta', 10000.0),
             rms_norm_eps=hf.get('rms_norm_eps', 1e-5),
-            sliding_window=hf.get('sliding_window'),
+            # Qwen2 config.json carries sliding_window even when
+            # use_sliding_window is false — honor the switch (Mistral
+            # configs have no switch; absent means enabled-if-set).
+            sliding_window=(
+                hf.get('sliding_window')
+                if hf.get('use_sliding_window', True)
+                else None
+            ),
             tie_word_embeddings=hf.get('tie_word_embeddings', False),
+            attention_bias=hf.get(
+                'attention_bias', hf.get('model_type') == 'qwen2'
+            ),
         )
 
 
@@ -78,12 +92,19 @@ def init(rng: jax.Array, cfg: MistralConfig) -> dict:
     keys = jax.random.split(rng, 3)
     layers = []
     for li in range(cfg.num_layers):
-        ks = jax.random.split(jax.random.fold_in(keys[0], li), 7)
+        ks = jax.random.split(jax.random.fold_in(keys[0], li), 10)
+
+        def proj(kkey, bkey, shape):
+            out = {'kernel': normal(kkey, shape)}
+            if cfg.attention_bias:
+                out['bias'] = normal(bkey, (shape[-1],))
+            return out
+
         layers.append(
             {
-                'q': {'kernel': normal(ks[0], (h, q_out))},
-                'k': {'kernel': normal(ks[1], (h, kv_out))},
-                'v': {'kernel': normal(ks[2], (h, kv_out))},
+                'q': proj(ks[0], ks[7], (h, q_out)),
+                'k': proj(ks[1], ks[8], (h, kv_out)),
+                'v': proj(ks[2], ks[9], (h, kv_out)),
                 'o': {'kernel': normal(ks[3], (q_out, h))},
                 'attn_ln': {'scale': np.ones((h,), np.float32)},
                 'gate': {'kernel': normal(ks[4], (h, i))},
@@ -121,7 +142,7 @@ def init_on_device(rng: jax.Array, cfg: MistralConfig) -> dict:
     dtype = jnp.dtype(cfg.dtype)
     scale = 0.02
 
-    keys = jax.random.split(rng, 9)
+    keys = jax.random.split(rng, 12)
 
     @jax.jit
     def build():
@@ -130,12 +151,18 @@ def init_on_device(rng: jax.Array, cfg: MistralConfig) -> dict:
                 dtype
             ) * scale
 
+        def proj(kkey, bkey, shape):
+            out = {'kernel': normal(kkey, shape)}
+            if cfg.attention_bias:
+                out['bias'] = normal(bkey, (L, shape[-1]))
+            return out
+
         params = {
             'embed': normal(keys[0], (cfg.vocab_size, h)),
             'layers': {
-                'q': {'kernel': normal(keys[1], (L, h, q_out))},
-                'k': {'kernel': normal(keys[2], (L, h, kv_out))},
-                'v': {'kernel': normal(keys[3], (L, h, kv_out))},
+                'q': proj(keys[1], keys[9], (L, h, q_out)),
+                'k': proj(keys[2], keys[10], (L, h, kv_out)),
+                'v': proj(keys[3], keys[11], (L, h, kv_out)),
                 'o': {'kernel': normal(keys[4], (L, q_out, h))},
                 'attn_ln': {'scale': jnp.ones((L, h), dtype)},
                 'gate': {'kernel': normal(keys[5], (L, h, i))},
@@ -224,9 +251,18 @@ def _forward(
 
     def layer(x, lp):
         normed = common.rms_norm(x, lp['attn_ln']['scale'], cfg.rms_norm_eps)
-        q = common.split_heads(common.dense(normed, lp['q']['kernel']), cfg.num_heads)
-        k = common.split_heads(common.dense(normed, lp['k']['kernel']), cfg.num_kv_heads)
-        v = common.split_heads(common.dense(normed, lp['v']['kernel']), cfg.num_kv_heads)
+        q = common.split_heads(
+            common.dense(normed, lp['q']['kernel'], lp['q'].get('bias')),
+            cfg.num_heads,
+        )
+        k = common.split_heads(
+            common.dense(normed, lp['k']['kernel'], lp['k'].get('bias')),
+            cfg.num_kv_heads,
+        )
+        v = common.split_heads(
+            common.dense(normed, lp['v']['kernel'], lp['v'].get('bias')),
+            cfg.num_kv_heads,
+        )
         q = common.apply_rope(q, cos, sin, positions)
         k = common.apply_rope(k, cos, sin, positions)
         if use_sp:
@@ -334,13 +370,13 @@ def _decode_core(
         k_cache_l = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
         v_cache_l = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
         normed = common.rms_norm(x, lp['attn_ln']['scale'], cfg.rms_norm_eps)
-        q = common.dense(normed, lp['q']['kernel']).reshape(
+        q = common.dense(normed, lp['q']['kernel'], lp['q'].get('bias')).reshape(
             -1, cfg.num_heads, cfg.head_size
         )
-        k = common.dense(normed, lp['k']['kernel']).reshape(
+        k = common.dense(normed, lp['k']['kernel'], lp['k'].get('bias')).reshape(
             -1, cfg.num_kv_heads, cfg.head_size
         )
-        v = common.dense(normed, lp['v']['kernel']).reshape(
+        v = common.dense(normed, lp['v']['kernel'], lp['v'].get('bias')).reshape(
             -1, cfg.num_kv_heads, cfg.head_size
         )
         # RoPE at each sequence's own position ([B, 1, N, Hd] view).
@@ -500,12 +536,17 @@ def param_specs(cfg: MistralConfig, params: dict | None = None) -> dict:
     """
     col = {'kernel': P(None, None, 'model')}
     row = {'kernel': P(None, 'model', None)}
+    if cfg.attention_bias:
+        # Stacked [L, out] biases shard with their column-parallel kernels.
+        qkv = {'kernel': P(None, None, 'model'), 'bias': P(None, 'model')}
+    else:
+        qkv = col
     specs = {
         'embed': P(None, None),
         'layers': {
-            'q': dict(col),
-            'k': dict(col),
-            'v': dict(col),
+            'q': dict(qkv),
+            'k': dict(qkv),
+            'v': dict(qkv),
             'o': dict(row),
             'attn_ln': {'scale': P(None)},
             'gate': dict(col),
@@ -527,17 +568,29 @@ def params_from_hf(state: dict[str, np.ndarray], cfg: MistralConfig) -> dict:
     """Convert HF ``MistralForCausalLM``/``MistralModel`` weights."""
     sd = {k.removeprefix('model.'): v for k, v in state.items()}
 
-    def lin(key):
-        return {'kernel': np.ascontiguousarray(sd[key].T)}
+    def lin(key, bias_ok=False):
+        out = {'kernel': np.ascontiguousarray(sd[key].T)}
+        bias_key = key.removesuffix('.weight') + '.bias'
+        if bias_key in sd:
+            if not bias_ok:
+                # Only Q/K/V biases flow through the forward passes; a
+                # checkpoint with e.g. an o_proj bias (HF Llama with
+                # attention_bias=true) must fail loudly, not silently
+                # drop the weight and diverge from HF.
+                raise ValueError(
+                    f'{bias_key}: bias unsupported on this projection'
+                )
+            out['bias'] = sd[bias_key]
+        return out
 
     layers = []
     for i in range(cfg.num_layers):
         p = f'layers.{i}'
         layers.append(
             {
-                'q': lin(f'{p}.self_attn.q_proj.weight'),
-                'k': lin(f'{p}.self_attn.k_proj.weight'),
-                'v': lin(f'{p}.self_attn.v_proj.weight'),
+                'q': lin(f'{p}.self_attn.q_proj.weight', bias_ok=True),
+                'k': lin(f'{p}.self_attn.k_proj.weight', bias_ok=True),
+                'v': lin(f'{p}.self_attn.v_proj.weight', bias_ok=True),
                 'o': lin(f'{p}.self_attn.o_proj.weight'),
                 'attn_ln': {'scale': sd[f'{p}.input_layernorm.weight']},
                 'gate': lin(f'{p}.mlp.gate_proj.weight'),

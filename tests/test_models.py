@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from distllm_tpu.models import bert as jbert
 from distllm_tpu.models import esm2 as jesm
@@ -92,6 +93,73 @@ def test_mistral_matches_hf(np_rng):
         ).last_hidden_state.numpy()
     ours = np.asarray(jmistral.apply(params, cfg, ids, mask))
     np.testing.assert_allclose(ours, ref, atol=3e-5, rtol=1e-4)
+
+
+def test_qwen2_matches_hf(np_rng):
+    """Qwen2 = Mistral architecture + Q/K/V biases; same module serves it
+    (auto-dispatch via model_type, auto.py _FAMILIES)."""
+    from transformers import Qwen2Config, Qwen2Model
+
+    hf_cfg = Qwen2Config(
+        vocab_size=101,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        use_sliding_window=False,
+    )
+    model = Qwen2Model(hf_cfg).eval()
+    hf_dict = hf_cfg.to_dict()
+    cfg = jmistral.MistralConfig.from_hf_config(hf_dict)
+    assert cfg.attention_bias  # inferred from model_type == 'qwen2'
+    # use_sliding_window=False must win over the sliding_window value the
+    # Qwen2 config carries anyway.
+    assert cfg.sliding_window is None
+    cfg.dtype = 'float32'
+    params = jmistral.params_from_hf(_to_numpy_state(model), cfg)
+    assert 'bias' in params['layers']['q']
+
+    ids, mask = _rand_batch(np_rng, 2, 12, 101)
+    with torch.no_grad():
+        ref = model(
+            input_ids=torch.tensor(ids.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+        ).last_hidden_state.numpy()
+    ours = np.asarray(jmistral.apply(params, cfg, ids, mask))
+    np.testing.assert_allclose(ours, ref, atol=3e-5, rtol=1e-4)
+
+
+def test_qwen2_decode_matches_prefill(np_rng):
+    """The biased projections must flow through the paged decode path too:
+    greedy decode_step logits == prefill logits at the same position."""
+    cfg = jmistral.MistralConfig(
+        vocab_size=64, hidden_size=16, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=32, attention_bias=True,
+        dtype='float32',
+    )
+    params = jmistral.init(jax.random.PRNGKey(0), cfg)
+    ids, mask = _rand_batch(np_rng, 1, 6, 64)
+    hidden, k, v = jmistral.prefill(params, cfg, ids, mask)
+    want = np.asarray(jmistral.logits(params, cfg, hidden))[0, -1]
+
+    from distllm_tpu.generate.engine.engine import _write_prefill_all_layers
+
+    bs, nb = 4, 8
+    kshape = (cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_size)
+    k_cache = jnp.zeros(kshape, jnp.float32)
+    v_cache = jnp.zeros(kshape, jnp.float32)
+    table = jnp.asarray([[1, 2, 0, 0]], jnp.int32)
+    k_cache, v_cache = _write_prefill_all_layers(
+        k_cache, v_cache, k, v, table, jnp.asarray([6], jnp.int32)
+    )
+    lg, _, _ = jmistral.decode_step(
+        params, cfg, jnp.asarray(ids[:, -1]), jnp.asarray([5], jnp.int32),
+        k_cache, v_cache, table, jnp.asarray([6], jnp.int32),
+    )
+    np.testing.assert_allclose(np.asarray(lg)[0], want, atol=2e-5)
 
 
 def test_mistral_logits_and_prefill(np_rng):
