@@ -9,6 +9,14 @@ batch is split into microbatches, and activations flow stage-to-stage with
 ``lax.ppermute`` in the classic ``M + P - 1``-step schedule. Autodiff works
 through the permutes, so the same function serves training (GPipe backward)
 under ``jax.grad``.
+
+Status framing (honest scope): this is a *library capability* exercised by
+its unit suite (``tests/test_pipeline.py``), not a serving-engine mode — no
+model config enables pp for the engine, mirroring the reference, whose own
+serving never runs pp either. On a v5e slice, TP over ICI (engine mesh
+path) dominates pp for the model sizes this framework targets; wire pp
+into the engine only when a model no longer fits TP-sharded in a slice's
+combined HBM.
 """
 
 from __future__ import annotations
