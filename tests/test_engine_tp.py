@@ -83,6 +83,26 @@ def test_tp4_matches_single_device(model):
         _generate(cfg, params, mesh, [[1, 2, 3]])
 
 
+def test_tp2_qwen2_biases_match_single_device():
+    """Q/K/V biases (Qwen2 family) shard with their column-parallel
+    kernels — the bias specs must keep TP token-exact, not just run."""
+    cfg = mistral.MistralConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, attention_bias=True,
+        dtype='float32',
+    )
+    params = mistral.init(jax.random.PRNGKey(3), cfg)
+    assert 'bias' in params['layers']['q']
+    rng = np.random.default_rng(2)
+    prompts = [
+        list(rng.integers(1, cfg.vocab_size, size=n)) for n in (5, 18, 9)
+    ]
+    single = _generate(cfg, params, None, prompts)
+    mesh = make_mesh(MeshSpec(data=1, model=2), devices=jax.devices()[:2])
+    tp = _generate(cfg, params, mesh, prompts)
+    assert single == tp
+
+
 def test_tp2_with_continuous_batching_churn(model):
     """Requests joining/leaving the batch (staggered finishes) under TP."""
     cfg, params = model
