@@ -140,11 +140,18 @@ class EngineConfig(BaseConfig):
 
 
 class LLMEngine:
-    """Drives a Mistral-family decoder with paged KV + continuous batching."""
+    """Drives a Mistral-family decoder with paged KV + continuous batching.
+
+    ``model_cfg`` may be a :class:`~distllm_tpu.models.mixtral.
+    MixtralConfig` too: the shared serving machinery dispatches the MLP
+    block on pytree structure (``models/mistral.py _mlp_block``), so
+    dense SwiGLU and MoE families serve through one engine — mirroring
+    the reference, whose vLLM backend serves both.
+    """
 
     def __init__(
         self,
-        model_cfg: mistral.MistralConfig,
+        model_cfg: 'mistral.MistralConfig | object',
         params: dict,
         tokenizer,
         config: EngineConfig | None = None,

@@ -179,6 +179,35 @@ def init_on_device(rng: jax.Array, cfg: MistralConfig) -> dict:
     return build()
 
 
+def _mlp_block(normed: jnp.ndarray, lp: dict, cfg) -> jnp.ndarray:
+    """Per-layer MLP: dense SwiGLU, or the Mixtral MoE bank when the layer
+    carries a router (pytree STRUCTURE is static under jit, so this
+    branch costs nothing at trace time). One home for the block lets the
+    whole serving machinery — prefill, rolled/unrolled paged decode —
+    serve both families (the reference's vLLM serves Mistral and Mixtral
+    through one engine too)."""
+    if 'router' in lp:
+        from distllm_tpu.models.mixtral import moe_mlp
+
+        batched = normed[:, None] if normed.ndim == 2 else normed
+        out = moe_mlp(
+            batched,
+            lp['router']['kernel'],
+            lp['gate']['kernel'],
+            lp['up']['kernel'],
+            lp['down']['kernel'],
+            # Router present => the config is MoE; a missing field must
+            # raise, not silently route top-2.
+            cfg.experts_per_token,
+        )
+        return out[:, 0] if normed.ndim == 2 else out
+    return common.dense(
+        common.silu(common.dense(normed, lp['gate']['kernel']))
+        * common.dense(normed, lp['up']['kernel']),
+        lp['down']['kernel'],
+    )
+
+
 def _rope_tables(cfg: MistralConfig, max_len: int):
     cos, sin = common.rope_frequencies(cfg.head_size, max_len, cfg.rope_theta)
     return jnp.asarray(cos), jnp.asarray(sin)
@@ -287,12 +316,7 @@ def _forward(
             attn = common.sdpa(q, k, v, mask=mask)
         x = x + common.dense(common.merge_heads(attn), lp['o']['kernel'])
         normed2 = common.rms_norm(x, lp['mlp_ln']['scale'], cfg.rms_norm_eps)
-        mlp = common.dense(
-            common.silu(common.dense(normed2, lp['gate']['kernel']))
-            * common.dense(normed2, lp['up']['kernel']),
-            lp['down']['kernel'],
-        )
-        x = x + mlp
+        x = x + _mlp_block(normed2, lp, cfg)
         return x, (k, v) if collect_kv else None
 
     x, kv = jax.lax.scan(layer, x, params['layers'])
@@ -390,11 +414,7 @@ def _decode_core(
             attn.reshape(-1, cfg.num_heads * cfg.head_size), lp['o']['kernel']
         )
         normed2 = common.rms_norm(x, lp['mlp_ln']['scale'], cfg.rms_norm_eps)
-        mlp = common.dense(
-            common.silu(common.dense(normed2, lp['gate']['kernel']))
-            * common.dense(normed2, lp['up']['kernel']),
-            lp['down']['kernel'],
-        )
+        mlp = _mlp_block(normed2, lp, cfg)
         k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k_cache_l, li, 0)
         v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v_cache_l, li, 0)
         return (x + mlp, k_cache, v_cache), None

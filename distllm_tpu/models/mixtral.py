@@ -41,6 +41,7 @@ class MixtralConfig(BaseConfig):
     max_position_embeddings: int = 32768
     rope_theta: float = 1e6
     rms_norm_eps: float = 1e-5
+    sliding_window: int | None = None
     tie_word_embeddings: bool = False
     dtype: str = 'bfloat16'
 
@@ -62,6 +63,7 @@ class MixtralConfig(BaseConfig):
             max_position_embeddings=hf.get('max_position_embeddings', 32768),
             rope_theta=hf.get('rope_theta', 1e6),
             rms_norm_eps=hf.get('rms_norm_eps', 1e-5),
+            sliding_window=hf.get('sliding_window'),
             tie_word_embeddings=hf.get('tie_word_embeddings', False),
         )
 
@@ -150,64 +152,18 @@ def apply(
 ) -> jnp.ndarray:
     """Dense causal forward: ``[B, S]`` → last hidden states ``[B, S, H]``.
 
-    ``seq_parallel`` activates ring/Ulysses attention over the ``seq`` mesh
-    axis exactly as in :mod:`distllm_tpu.models.mistral`.
+    Delegates to the shared family forward (``models/mistral.py
+    _forward``), which dispatches the MLP block on pytree structure
+    (``_mlp_block`` sees the router and runs :func:`moe_mlp`) — one
+    implementation for masks (incl. sliding window), RoPE, GQA attention,
+    and ``seq_parallel`` ring/Ulysses, so the families cannot drift.
     """
-    dtype = jnp.dtype(cfg.dtype)
-    b, s = input_ids.shape
-    cos, sin = common.rope_frequencies(cfg.head_size, s, cfg.rope_theta)
-    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
-    x = jnp.asarray(params['embed'])[input_ids].astype(dtype)
-    use_sp = (
-        seq_parallel is not None
-        and mesh is not None
-        and mesh.shape.get('seq', 1) > 1
+    from distllm_tpu.models import mistral
+
+    return mistral.apply(
+        params, cfg, input_ids, attention_mask,
+        mesh=mesh, seq_parallel=seq_parallel,
     )
-    if use_sp:
-        mask = None
-    else:
-        causal = common.causal_mask(s, s)
-        mask = causal[None, None] & attention_mask[:, None, None, :].astype(bool)
-
-    def layer(x, lp):
-        normed = common.rms_norm(x, lp['attn_ln']['scale'], cfg.rms_norm_eps)
-        q = common.split_heads(common.dense(normed, lp['q']['kernel']), cfg.num_heads)
-        k = common.split_heads(common.dense(normed, lp['k']['kernel']), cfg.num_kv_heads)
-        v = common.split_heads(common.dense(normed, lp['v']['kernel']), cfg.num_kv_heads)
-        q = common.apply_rope(q, cos, sin)
-        k = common.apply_rope(k, cos, sin)
-        if use_sp:
-            from distllm_tpu.ops.ring_attention import (
-                ring_attention,
-                ulysses_attention,
-            )
-
-            sp_fn = ring_attention if seq_parallel == 'ring' else ulysses_attention
-            n_rep = cfg.num_heads // cfg.num_kv_heads
-            attn = sp_fn(
-                q,
-                common.repeat_kv(k, n_rep),
-                common.repeat_kv(v, n_rep),
-                mesh,
-                kv_mask=attention_mask,
-                causal=True,
-            )
-        else:
-            attn = common.sdpa(q, k, v, mask=mask)
-        x = x + common.dense(common.merge_heads(attn), lp['o']['kernel'])
-        normed2 = common.rms_norm(x, lp['mlp_ln']['scale'], cfg.rms_norm_eps)
-        x = x + moe_mlp(
-            normed2,
-            lp['router']['kernel'],
-            lp['gate']['kernel'],
-            lp['up']['kernel'],
-            lp['down']['kernel'],
-            cfg.experts_per_token,
-        )
-        return x, None
-
-    x, _ = jax.lax.scan(layer, x, params['layers'])
-    return common.rms_norm(x, params['final_ln']['scale'], cfg.rms_norm_eps)
 
 
 def logits(params: dict, cfg: MixtralConfig, hidden: jnp.ndarray) -> jnp.ndarray:
@@ -216,6 +172,28 @@ def logits(params: dict, cfg: MixtralConfig, hidden: jnp.ndarray) -> jnp.ndarray
     else:
         kernel = jnp.asarray(params['lm_head'])
     return common.dense(hidden, kernel).astype(jnp.float32)
+
+
+def prefill(params: dict, cfg: MixtralConfig, input_ids, attention_mask):
+    """Serving prefill — the shared machinery in :mod:`.mistral` handles
+    MoE layers by pytree structure (``_mlp_block``), so Mixtral serves
+    through the same paged engine (the reference's vLLM serves both
+    families through one engine as well)."""
+    from distllm_tpu.models import mistral
+
+    return mistral.prefill(params, cfg, input_ids, attention_mask)
+
+
+def decode_step(params: dict, cfg: MixtralConfig, *args, **kwargs):
+    from distllm_tpu.models import mistral
+
+    return mistral.decode_step(params, cfg, *args, **kwargs)
+
+
+def decode_loop(params: dict, cfg: MixtralConfig, *args, **kwargs):
+    from distllm_tpu.models import mistral
+
+    return mistral.decode_loop(params, cfg, *args, **kwargs)
 
 
 def param_specs(cfg: MixtralConfig, params: dict | None = None) -> dict:

@@ -305,6 +305,65 @@ def test_mixtral_matches_hf(np_rng):
     np.testing.assert_allclose(ours, ref, atol=5e-5, rtol=1e-4)
 
 
+def test_mixtral_serving_decode_matches_apply(np_rng):
+    """Mixtral must flow through the shared paged serving machinery: the
+    engine-facing prefill + greedy decode_step reproduce apply()'s
+    next-token logits (MoE routing inside the decode layer loop)."""
+    from distllm_tpu.generate.engine.engine import _write_prefill_all_layers
+    from distllm_tpu.models import mixtral as jmix
+
+    cfg = jmix.MixtralConfig(
+        vocab_size=64, hidden_size=16, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=32, num_experts=4,
+        experts_per_token=2, dtype='float32',
+    )
+    params = jmix.init(jax.random.PRNGKey(0), cfg)
+    ids, mask = _rand_batch(np_rng, 1, 6, 64)
+    hidden, k, v = jmix.prefill(params, cfg, ids, mask)
+    # prefill's hidden must agree with the family's own apply().
+    np.testing.assert_allclose(
+        np.asarray(hidden), np.asarray(jmix.apply(params, cfg, ids, mask)),
+        atol=1e-5,
+    )
+    want = np.asarray(jmix.logits(params, cfg, hidden))[0, -1]
+
+    bs, nb = 4, 8
+    kshape = (cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_size)
+    k_cache = jnp.zeros(kshape, jnp.float32)
+    v_cache = jnp.zeros(kshape, jnp.float32)
+    table = jnp.asarray([[1, 2, 0, 0]], jnp.int32)
+    k_cache, v_cache = _write_prefill_all_layers(
+        k_cache, v_cache, k, v, table, jnp.asarray([6], jnp.int32)
+    )
+    for unroll in (False, True):
+        lg, _, _ = jmix.decode_step(
+            params, cfg, jnp.asarray(ids[:, -1]), jnp.asarray([5], jnp.int32),
+            jnp.array(k_cache), jnp.array(v_cache), table,
+            jnp.asarray([6], jnp.int32), layer_unroll=unroll,
+        )
+        np.testing.assert_allclose(np.asarray(lg)[0], want, atol=2e-5)
+    # And the full engine serves it end to end.
+    from distllm_tpu.generate.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+
+    class _Tok:
+        eos_id = None
+
+    engine = LLMEngine(
+        cfg, params, _Tok(),
+        EngineConfig(block_size=4, num_blocks=16, max_num_seqs=2,
+                     max_model_len=32, prefill_min_bucket=8),
+    )
+    outs = engine.generate_ids(
+        [[5, 9, 17], [3, 20]], SamplingParams(temperature=0.0, max_tokens=4)
+    )
+    engine.shutdown()
+    assert all(len(o) == 4 for o in outs), outs
+
+
 def test_mixtral_ep_sharding_matches_single_device():
     """EP x TP over the 8-device mesh == single-device numerics."""
     from distllm_tpu.models import mixtral as jmix
