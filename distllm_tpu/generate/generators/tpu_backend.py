@@ -56,7 +56,7 @@ class TpuGenerator:
     def __init__(self, config: TpuGeneratorConfig) -> None:
         import jax
 
-        from distllm_tpu.models import mistral
+        from distllm_tpu.models import decoder_family
         from distllm_tpu.models.loader import read_checkpoint, read_hf_config
         from distllm_tpu.models.tokenizer import HFTokenizer
         from distllm_tpu.parallel.mesh import MeshSpec, make_mesh
@@ -64,8 +64,13 @@ class TpuGenerator:
 
         self.config = config
         hf_cfg = read_hf_config(config.pretrained_model_name_or_path)
-        model_cfg = mistral.MistralConfig.from_hf_config(hf_cfg)
-        params = mistral.params_from_hf(
+        # Dispatch on the checkpoint's model_type (the vLLM analogue of
+        # serving any supported architecture from one backend): the
+        # Mistral module covers mistral/llama/qwen2; Mixtral adds the
+        # MoE expert banks — both serve through the same engine.
+        cfg_cls, family = decoder_family(hf_cfg.get('model_type', 'mistral'))
+        model_cfg = cfg_cls.from_hf_config(hf_cfg)
+        params = family.params_from_hf(
             read_checkpoint(config.pretrained_model_name_or_path), model_cfg
         )
         quant_mode = normalize_mode(config.quantization)
@@ -82,7 +87,7 @@ class TpuGenerator:
                 devices=jax.devices()[: config.tensor_parallel_size],
             )
             params = shard_pytree(
-                params, mistral.param_specs(model_cfg, params), mesh
+                params, family.param_specs(model_cfg, params), mesh
             )
         tokenizer = HFTokenizer(
             config.tokenizer_name or config.pretrained_model_name_or_path,

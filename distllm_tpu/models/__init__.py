@@ -8,3 +8,31 @@ HuggingFace checkpoints. This replaces the reference's dependence on
 ``transformers.AutoModel`` forward passes (``distllm/embed/encoders/auto.py``)
 with compiled, shardable JAX forwards.
 """
+
+from __future__ import annotations
+
+
+def decoder_family(model_type: str):
+    """(config_cls, module) for a DECODER checkpoint's HF ``model_type``.
+
+    One registry for every serving entry point (engine backends, chat
+    server boot), so adding a family happens in one place. Encoder-only
+    families (bert/esm/modernbert) live in the embed auto-encoder's table
+    (``embed/encoders/auto.py``) — asking for one here is a loud error,
+    not a silent fall-through to the Mistral converter.
+    """
+    from distllm_tpu.models import mistral, mixtral
+
+    families = {
+        'mistral': (mistral.MistralConfig, mistral),
+        'llama': (mistral.MistralConfig, mistral),
+        'qwen2': (mistral.MistralConfig, mistral),
+        'mixtral': (mixtral.MixtralConfig, mixtral),
+    }
+    try:
+        return families[model_type]
+    except KeyError:
+        raise ValueError(
+            f'Unsupported decoder model_type {model_type!r}; '
+            f'supported: {sorted(families)}'
+        ) from None

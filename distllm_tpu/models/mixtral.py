@@ -123,6 +123,17 @@ def moe_mlp(
     combine — expert parallelism as XLA sees it.
     """
     dtype = x.dtype
+
+    def deq(w):
+        # Expert banks may arrive weight-only quantized (QTensor); the
+        # dequant happens HERE, at point of use — inside the layer loop,
+        # so only one layer's experts materialize as floats at a time
+        # (same policy as common.dense).
+        return (w.dequantize() if hasattr(w, 'dequantize') else w).astype(
+            dtype
+        )
+
+    gate, up, down = deq(gate), deq(up), deq(down)
     logits = jnp.einsum('bsh,he->bse', x.astype(jnp.float32), router_kernel.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)  # [B, S, E]
     top_w, top_idx = jax.lax.top_k(probs, experts_per_token)
@@ -133,9 +144,9 @@ def moe_mlp(
         * top_w[..., None],
         axis=-2,
     )
-    hidden = jnp.einsum('bsh,ehi->besi', x, gate.astype(dtype))
-    hidden = jax.nn.silu(hidden) * jnp.einsum('bsh,ehi->besi', x, up.astype(dtype))
-    expert_out = jnp.einsum('besi,eih->besh', hidden, down.astype(dtype))
+    hidden = jnp.einsum('bsh,ehi->besi', x, gate)
+    hidden = jax.nn.silu(hidden) * jnp.einsum('bsh,ehi->besi', x, up)
+    expert_out = jnp.einsum('besi,eih->besh', hidden, down)
     return jnp.einsum(
         'besh,bse->bsh', expert_out, combine.astype(dtype)
     )

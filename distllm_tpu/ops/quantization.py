@@ -144,7 +144,10 @@ def quantize_int8(w: np.ndarray, out_dtype: str = 'bfloat16') -> QTensor:
     so dequant is a single fused multiply.
     """
     w = np.asarray(w, dtype=np.float32)
-    reduce_axes = tuple(range(1 if w.ndim >= 3 else 0, w.ndim - 1))
+    # Reduce ONLY the input dim (second-to-last): every leading dim —
+    # layer stack [L, in, out], expert banks [L, E, in, out] — keeps its
+    # own per-channel dynamic range.
+    reduce_axes = (w.ndim - 2,)
     absmax = np.abs(w).max(axis=reduce_axes, keepdims=True)
     scale = (absmax / 127.0).astype(np.float32)
     scale = np.where(scale == 0.0, 1.0, scale)
@@ -196,11 +199,12 @@ def quantize_nf4(
 
 
 def _should_quantize(path: tuple, leaf: Any, min_size: int) -> bool:
-    # Linear kernels are 2-D [in, out] or stacked-per-layer 3-D [L, in, out]
-    # (models/common.py stack_layers); anything else stays float.
+    # Linear kernels are 2-D [in, out], stacked-per-layer 3-D [L, in, out]
+    # (models/common.py stack_layers), or stacked expert banks 4-D
+    # [L, E, in, out] (models/mixtral.py); anything else stays float.
     if (
         not hasattr(leaf, 'ndim')
-        or leaf.ndim not in (2, 3)
+        or leaf.ndim not in (2, 3, 4)
         or leaf.size < min_size
         or not jnp.issubdtype(leaf.dtype, jnp.floating)
     ):
@@ -209,8 +213,7 @@ def _should_quantize(path: tuple, leaf: Any, min_size: int) -> bool:
     # Embedding tables, norm scales, biases, the output head, and MoE
     # router kernels stay full precision (bnb quantizes only nn.Linear
     # weights and exempts lm_head via llm_int8_skip_modules; routers are
-    # tiny [H, E] and routing is precision-sensitive — and they feed
-    # moe_mlp's raw einsums, which expect float arrays). Stacked biases
+    # tiny [H, E] and routing is precision-sensitive). Stacked biases
     # are 2-D [L, out], hence the name gate rather than an ndim gate.
     return not any(
         tag in keys
@@ -304,11 +307,10 @@ def quantize_pytree_abstract(
         if not _should_quantize(path, leaf, min_size):
             return make_leaf(leaf.shape, leaf.dtype)
         shape = tuple(leaf.shape)
-        # Mirrors quantize_int8: per-output-channel scales, keepdims over
-        # the reduced axes ([L, 1, out] for stacked 3-D, [1, out] for 2-D).
-        scale_shape = (
-            (shape[0], 1, shape[-1]) if len(shape) >= 3 else (1, shape[-1])
-        )
+        # Mirrors quantize_int8: only the input dim (second-to-last)
+        # reduces, keepdims — [1, out] for 2-D, [L, 1, out] for stacked
+        # 3-D, [L, E, 1, out] for expert banks.
+        scale_shape = (*shape[:-2], 1, shape[-1])
         return QTensor(
             make_leaf(shape, jnp.int8),
             make_leaf(scale_shape, jnp.float32),

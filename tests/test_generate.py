@@ -228,3 +228,14 @@ def test_distributed_tokenization_worker(tmp_path):
     ds = load_from_disk(shard)
     assert ds[0]['input_ids'][0] == 2  # [CLS]
     assert ds[0]['labels'] == ds[0]['input_ids']
+
+
+def test_decoder_family_dispatch():
+    from distllm_tpu.models import decoder_family, mistral, mixtral
+
+    cfg_cls, family = decoder_family('mixtral')
+    assert cfg_cls is mixtral.MixtralConfig and family is mixtral
+    cfg_cls, family = decoder_family('qwen2')
+    assert cfg_cls is mistral.MistralConfig and family is mistral
+    with pytest.raises(ValueError, match='Unsupported decoder'):
+        decoder_family('bert')

@@ -364,6 +364,52 @@ def test_mixtral_serving_decode_matches_apply(np_rng):
     assert all(len(o) == 4 for o in outs), outs
 
 
+def test_mixtral_int8_serving(np_rng):
+    """Weight-only int8 covers the 4-D expert banks (the bulk of an MoE
+    model); the quantized engine must serve, and quantized decode logits
+    must sit near the float ones (per-expert-channel scales)."""
+    from distllm_tpu.generate.engine.engine import (
+        EngineConfig,
+        LLMEngine,
+        SamplingParams,
+    )
+    from distllm_tpu.models import mixtral as jmix
+    from distllm_tpu.ops.quantization import QTensor, quantize_pytree
+
+    cfg = jmix.MixtralConfig(
+        vocab_size=64, hidden_size=16, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=32, num_experts=4,
+        experts_per_token=2, dtype='float32',
+    )
+    params = jmix.init(jax.random.PRNGKey(1), cfg)
+    qparams = quantize_pytree(params, mode='int8', min_size=1)
+    assert isinstance(qparams['layers']['gate']['kernel'], QTensor)
+
+    ids, mask = _rand_batch(np_rng, 1, 5, 64)
+    want = np.asarray(
+        jmix.logits(params, cfg, jmix.apply(params, cfg, ids, mask))
+    )[0, -1]
+    got = np.asarray(
+        jmix.logits(qparams, cfg, jmix.apply(qparams, cfg, ids, mask))
+    )[0, -1]
+    # int8 error is small but nonzero; the distributions must stay close.
+    assert np.abs(got - want).max() < 0.05
+
+    class _Tok:
+        eos_id = None
+
+    engine = LLMEngine(
+        cfg, qparams, _Tok(),
+        EngineConfig(block_size=4, num_blocks=16, max_num_seqs=2,
+                     max_model_len=32, prefill_min_bucket=8),
+    )
+    outs = engine.generate_ids(
+        [[5, 9, 17]], SamplingParams(temperature=0.0, max_tokens=4)
+    )
+    engine.shutdown()
+    assert len(outs[0]) == 4
+
+
 def test_mixtral_ep_sharding_matches_single_device():
     """EP x TP over the 8-device mesh == single-device numerics."""
     from distllm_tpu.models import mixtral as jmix

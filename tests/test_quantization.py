@@ -245,3 +245,55 @@ def test_quantized_params_shard_over_mesh(np_rng):
         mistral.apply(dequantize_pytree(qparams), cfg, ids, mask)
     )
     np.testing.assert_allclose(np.asarray(out), want, atol=1e-5, rtol=1e-5)
+
+
+def test_int8_expert_bank_roundtrip():
+    """4-D [L, E, in, out] expert banks quantize with per-(layer, expert,
+    channel) scales and dequantize close to the source."""
+    from distllm_tpu.ops.quantization import quantize_int8
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(2, 3, 16, 8)).astype(np.float32)
+    w[1, 2] *= 50.0  # one expert with a wild dynamic range
+    qt = quantize_int8(w)
+    assert qt.scale.shape == (2, 3, 1, 8)
+    err = np.abs(np.asarray(qt.dequantize(), np.float32) - w)
+    # Per-expert scales keep the mild experts accurate despite the wild one.
+    assert err[0].max() < 0.02
+    assert (err[1, 2] / 50.0).max() < 0.02
+
+
+def test_quantize_pytree_covers_expert_banks():
+    from distllm_tpu.ops.quantization import QTensor, quantize_pytree
+
+    rng = np.random.default_rng(1)
+    tree = {
+        'layers': {
+            'gate': {'kernel': jnp.asarray(rng.normal(size=(2, 4, 16, 8)), jnp.float32)},
+            'router': {'kernel': jnp.asarray(rng.normal(size=(2, 16, 4)), jnp.float32)},
+        }
+    }
+    out = quantize_pytree(tree, mode='int8', min_size=1)
+    assert isinstance(out['layers']['gate']['kernel'], QTensor)
+    # Routers are precision-sensitive and stay float.
+    assert not isinstance(out['layers']['router']['kernel'], QTensor)
+
+
+def test_abstract_quantizer_matches_real_for_expert_banks():
+    import jax
+
+    from distllm_tpu.ops.quantization import (
+        quantize_pytree,
+        quantize_pytree_abstract,
+    )
+
+    rng = np.random.default_rng(2)
+    tree = {'gate': {'kernel': jnp.asarray(rng.normal(size=(2, 3, 16, 8)), jnp.float32)}}
+    real = quantize_pytree(tree, mode='int8', min_size=1)
+    shapes = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+    )
+    abstract = quantize_pytree_abstract(shapes, mode='int8', min_size=1)
+    rq, aq = real['gate']['kernel'], abstract['gate']['kernel']
+    assert tuple(rq.q.shape) == tuple(aq.q.shape)
+    assert tuple(rq.scale.shape) == tuple(aq.scale.shape)
