@@ -16,19 +16,18 @@ from typing import Literal
 from pydantic import Field
 
 from distllm_tpu.embed.encoders.base import JaxEncoder
-from distllm_tpu.models import bert, esm2, mistral, mixtral, modernbert
+from distllm_tpu.models import bert, decoder_families, esm2, modernbert
 from distllm_tpu.models.loader import read_checkpoint, read_hf_config
 from distllm_tpu.models.tokenizer import HFTokenizer
 from distllm_tpu.utils import BaseConfig
 
+# Encoder-only families plus every decoder family (embedding models like
+# SFR-Embedding-Mistral ride the decoder stacks with last-token pooling).
 _FAMILIES = {
     'bert': (bert.BertConfig, bert),
-    'mistral': (mistral.MistralConfig, mistral),
-    'llama': (mistral.MistralConfig, mistral),
-    'qwen2': (mistral.MistralConfig, mistral),  # + Q/K/V biases
-    'mixtral': (mixtral.MixtralConfig, mixtral),
     'esm': (esm2.Esm2Config, esm2),
     'modernbert': (modernbert.ModernBertConfig, modernbert),
+    **decoder_families(),
 }
 
 

@@ -12,23 +12,31 @@ with compiled, shardable JAX forwards.
 from __future__ import annotations
 
 
-def decoder_family(model_type: str):
-    """(config_cls, module) for a DECODER checkpoint's HF ``model_type``.
+def decoder_families() -> dict:
+    """``model_type -> (config_cls, module)`` for every decoder family.
 
-    One registry for every serving entry point (engine backends, chat
-    server boot), so adding a family happens in one place. Encoder-only
-    families (bert/esm/modernbert) live in the embed auto-encoder's table
-    (``embed/encoders/auto.py``) — asking for one here is a loud error,
-    not a silent fall-through to the Mistral converter.
+    The single source of truth: the serving entry points dispatch through
+    :func:`decoder_family`, and the embed auto-encoder builds its table
+    from these rows plus the encoder-only families
+    (``embed/encoders/auto.py``) — a new decoder lands in one place.
     """
     from distllm_tpu.models import mistral, mixtral
 
-    families = {
+    return {
         'mistral': (mistral.MistralConfig, mistral),
         'llama': (mistral.MistralConfig, mistral),
         'qwen2': (mistral.MistralConfig, mistral),
         'mixtral': (mixtral.MixtralConfig, mixtral),
     }
+
+
+def decoder_family(model_type: str):
+    """(config_cls, module) for a DECODER checkpoint's HF ``model_type``.
+
+    Encoder-only families (bert/esm/modernbert) are a loud error here,
+    not a silent fall-through to the Mistral converter.
+    """
+    families = decoder_families()
     try:
         return families[model_type]
     except KeyError:

@@ -109,9 +109,44 @@ def sdpa(
     )
 
 
-def rope_frequencies(head_dim: int, max_len: int, theta: float) -> tuple[np.ndarray, np.ndarray]:
-    """Precompute RoPE cos/sin tables ``[max_len, head_dim//2]`` (host-side)."""
+def rope_frequencies(
+    head_dim: int,
+    max_len: int,
+    theta: float,
+    rope_scaling: dict | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Precompute RoPE cos/sin tables ``[max_len, head_dim//2]`` (host-side).
+
+    ``rope_scaling`` follows the HF config field: ``{'rope_type':
+    'llama3', 'factor', 'low_freq_factor', 'high_freq_factor',
+    'original_max_position_embeddings'}`` (Llama-3 frequency-banded
+    interpolation) or ``{'rope_type': 'linear', 'factor'}``. Unknown
+    types raise — silently ignoring a checkpoint's scaling would produce
+    wrong positions for every token past the original context.
+    """
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if rope_scaling:
+        kind = rope_scaling.get('rope_type', rope_scaling.get('type'))
+        if kind in (None, 'default'):
+            pass  # HF's explicit no-op scaling entry
+        elif kind == 'linear':
+            inv_freq = inv_freq / float(rope_scaling['factor'])
+        elif kind == 'llama3':
+            # HF _compute_llama3_parameters: low-frequency bands scale by
+            # 1/factor, high-frequency bands keep the base frequency, and
+            # the middle band interpolates smoothly.
+            factor = float(rope_scaling['factor'])
+            low = float(rope_scaling['low_freq_factor'])
+            high = float(rope_scaling['high_freq_factor'])
+            orig = float(rope_scaling['original_max_position_embeddings'])
+            wavelen = 2.0 * np.pi / inv_freq
+            smooth = (orig / wavelen - low) / (high - low)
+            smooth = np.clip(smooth, 0.0, 1.0)
+            inv_freq = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        else:
+            raise NotImplementedError(
+                f'rope_scaling type {kind!r} (supported: linear, llama3)'
+            )
     t = np.arange(max_len, dtype=np.float64)
     freqs = np.outer(t, inv_freq)
     return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)

@@ -38,6 +38,10 @@ class MistralConfig(BaseConfig):
     intermediate_size: int = 14336
     max_position_embeddings: int = 32768
     rope_theta: float = 10000.0
+    # HF rope_scaling dict (Llama-3 'llama3' banding, 'linear') — applied
+    # in the RoPE tables; unknown types raise rather than silently
+    # mis-position long contexts.
+    rope_scaling: dict | None = None
     rms_norm_eps: float = 1e-5
     sliding_window: int | None = None
     tie_word_embeddings: bool = False
@@ -62,6 +66,7 @@ class MistralConfig(BaseConfig):
             intermediate_size=hf['intermediate_size'],
             max_position_embeddings=hf.get('max_position_embeddings', 32768),
             rope_theta=hf.get('rope_theta', 10000.0),
+            rope_scaling=hf.get('rope_scaling'),
             rms_norm_eps=hf.get('rms_norm_eps', 1e-5),
             # Qwen2 config.json carries sliding_window even when
             # use_sliding_window is false — honor the switch (Mistral
@@ -209,7 +214,10 @@ def _mlp_block(normed: jnp.ndarray, lp: dict, cfg) -> jnp.ndarray:
 
 
 def _rope_tables(cfg: MistralConfig, max_len: int):
-    cos, sin = common.rope_frequencies(cfg.head_size, max_len, cfg.rope_theta)
+    cos, sin = common.rope_frequencies(
+        cfg.head_size, max_len, cfg.rope_theta,
+        getattr(cfg, 'rope_scaling', None),
+    )
     return jnp.asarray(cos), jnp.asarray(sin)
 
 

@@ -40,6 +40,7 @@ class MixtralConfig(BaseConfig):
     experts_per_token: int = 2
     max_position_embeddings: int = 32768
     rope_theta: float = 1e6
+    rope_scaling: dict | None = None
     rms_norm_eps: float = 1e-5
     sliding_window: int | None = None
     tie_word_embeddings: bool = False
@@ -62,6 +63,7 @@ class MixtralConfig(BaseConfig):
             experts_per_token=hf.get('num_experts_per_tok', 2),
             max_position_embeddings=hf.get('max_position_embeddings', 32768),
             rope_theta=hf.get('rope_theta', 1e6),
+            rope_scaling=hf.get('rope_scaling'),
             rms_norm_eps=hf.get('rms_norm_eps', 1e-5),
             sliding_window=hf.get('sliding_window'),
             tie_word_embeddings=hf.get('tie_word_embeddings', False),

@@ -95,6 +95,52 @@ def test_mistral_matches_hf(np_rng):
     np.testing.assert_allclose(ours, ref, atol=3e-5, rtol=1e-4)
 
 
+def test_llama3_rope_scaling_matches_hf(np_rng):
+    """Llama-3 checkpoints carry rope_scaling (llama3 frequency banding);
+    ignoring it mis-positions every token past the original context, so
+    the scaled tables are golden-tested against transformers."""
+    from transformers import LlamaConfig, LlamaModel
+
+    rope_scaling = {
+        'rope_type': 'llama3', 'factor': 8.0, 'low_freq_factor': 1.0,
+        'high_freq_factor': 4.0, 'original_max_position_embeddings': 16,
+    }
+    hf_cfg = LlamaConfig(
+        vocab_size=101, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        max_position_embeddings=128, rope_scaling=rope_scaling,
+        attention_bias=False,
+    )
+    model = LlamaModel(hf_cfg).eval()
+    cfg = jmistral.MistralConfig.from_hf_config(hf_cfg.to_dict())
+    assert cfg.rope_scaling is not None
+    cfg.dtype = 'float32'
+    params = jmistral.params_from_hf(_to_numpy_state(model), cfg)
+
+    # Long enough that scaled and unscaled tables genuinely differ.
+    ids, mask = _rand_batch(np_rng, 2, 48, 101)
+    with torch.no_grad():
+        ref = model(
+            input_ids=torch.tensor(ids.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+        ).last_hidden_state.numpy()
+    ours = np.asarray(jmistral.apply(params, cfg, ids, mask))
+    np.testing.assert_allclose(ours, ref, atol=5e-5, rtol=1e-4)
+    # And the scaling is actually load-bearing at these lengths:
+    cfg_unscaled = cfg.model_copy(update={'rope_scaling': None})
+    unscaled = np.asarray(jmistral.apply(params, cfg_unscaled, ids, mask))
+    assert np.abs(unscaled - ref).max() > 1e-3
+
+
+def test_rope_scaling_unknown_type_raises():
+    from distllm_tpu.models import common as jcommon
+
+    with pytest.raises(NotImplementedError, match='yarn'):
+        jcommon.rope_frequencies(
+            64, 32, 1e4, {'rope_type': 'yarn', 'factor': 4.0}
+        )
+
+
 def test_qwen2_matches_hf(np_rng):
     """Qwen2 = Mistral architecture + Q/K/V biases; same module serves it
     (auto-dispatch via model_type, auto.py _FAMILIES)."""
