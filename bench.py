@@ -285,9 +285,22 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
     assert engine is not None
     warmup_secs = time.perf_counter() - warmup_start
 
-    start = time.perf_counter()
-    outs = engine.generate_ids(prompts, sampling)
-    elapsed = time.perf_counter() - start
+    # DISTLLM_BENCH_PROFILE=<dir> wraps the timed region in a profiler
+    # trace (XPlane + TensorBoard format): on hardware this shows per-op
+    # device time for the decode windows — the ground truth the AOT HLO
+    # census (scripts/probe_decode_hlo.py) can only approximate.
+    profile_dir = os.environ.get('DISTLLM_BENCH_PROFILE')
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    try:
+        start = time.perf_counter()
+        outs = engine.generate_ids(prompts, sampling)
+        elapsed = time.perf_counter() - start
+    finally:
+        # Flush even when generation dies mid-decode — a partial trace of
+        # the failing run is exactly what the profile exists to capture.
+        if profile_dir:
+            jax.profiler.stop_trace()
     n_tokens = sum(len(o) for o in outs)
     throughput = n_tokens / elapsed
 
