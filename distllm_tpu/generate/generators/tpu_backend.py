@@ -45,10 +45,15 @@ class TpuGeneratorConfig(BaseConfig):
 
     @model_validator(mode='after')
     def _xor_top_p_min_p(self) -> 'TpuGeneratorConfig':
-        # Reference behavior (vllm_backend.py:48-60): top_p and min_p are
-        # mutually exclusive; min_p wins by default.
+        # Reference behavior (vllm_backend.py:48-60): an explicitly set
+        # top_p wins and min_p is ignored; min_p (default 0.1) applies
+        # otherwise. A reference config carrying only `top_p: 0.95` must
+        # load unchanged — min_p's own default cannot veto it. Only a
+        # config that EXPLICITLY sets both truthy values is ambiguous.
         if self.top_p and self.min_p:
-            raise ValueError('Only one of top_p or min_p can be set')
+            if 'min_p' in self.model_fields_set:
+                raise ValueError('Only one of top_p or min_p can be set')
+            self.min_p = 0.0
         return self
 
 

@@ -28,6 +28,7 @@ def test_examples_tree_exists():
         ('embed/modernbert.jsonl_chunk.workstation.yaml', 'embed'),
         ('generate/question_chunk.fake.local.yaml', 'generate'),
         ('generate/mistral7b.tpu.pod-slurm.nodes16.yaml', 'generate'),
+        ('generate/mixtral8x7b.tpu.tp8.yaml', 'generate'),
         ('tokenize/jsonl.local.yaml', 'tokenize'),
         ('mcqa/mcqa.local.yaml', 'mcqa'),
         ('mcqa/mcqa.boot-local-engine.yaml', 'mcqa'),
@@ -52,6 +53,18 @@ def test_example_parses(rel, config_cls):
         from distllm_tpu.rag.evaluate import EvalSuiteConfig as Config
     cfg = Config.from_yaml(path)
     assert cfg is not None
+    # The outer Config holds generator_config as a raw dict (validated on
+    # the worker) — construct the registered generator config here so a
+    # shipped example cannot pass CI while failing at worker startup.
+    gen_dict = getattr(cfg, 'generator_config', None)
+    if isinstance(gen_dict, dict) and gen_dict.get('name') in ('tpu', 'vllm'):
+        from distllm_tpu.generate.generators.tpu_backend import (
+            TpuGeneratorConfig,
+        )
+
+        inner = dict(gen_dict)
+        inner.pop('name')
+        TpuGeneratorConfig(**inner)
 
 
 def test_model_servers_registry_parses():
