@@ -42,6 +42,33 @@ class TpuGeneratorConfig(BaseConfig):
         description='Weight-only quantized serving; True means nf4 (the '
         "reference's bitsandbytes NF4 option).",
     )
+    # Serving perf knobs (same surface the bench exercises — production
+    # configs must be able to turn on what the measured numbers used).
+    # Defaults are None = inherit EngineConfig's documented defaults, so
+    # one place owns each default and reference-parity semantics (exact
+    # full-vocab sampling) hold unless a config opts in.
+    attn_backend: Literal['auto', 'xla', 'pallas'] = Field(
+        default='auto',
+        description="Decode attention kernel: 'auto' = the Pallas kernel "
+        'when the chip and head_dim support it, XLA otherwise.',
+    )
+    decode_steps: int | None = Field(
+        default=None,
+        ge=1,
+        description='Tokens per fused decode dispatch (amortizes the '
+        'host round trip; 1 restores per-token dispatch).',
+    )
+    sampling_top_window: int | None = Field(
+        default=None,
+        ge=0,
+        description='Sample from the top-K logits per step instead of '
+        'sorting the full vocab (0 = exact full-vocab semantics).',
+    )
+    decode_layer_unroll: bool | None = Field(
+        default=None,
+        description='Unroll the decode layer scan (folds stacked-weight '
+        'slices into the matmuls; longer one-time compile).',
+    )
 
     @model_validator(mode='after')
     def _xor_top_p_min_p(self) -> 'TpuGeneratorConfig':
@@ -110,6 +137,26 @@ class TpuGenerator:
                 max_num_seqs=config.max_num_seqs,
                 max_model_len=config.max_model_len,
                 quantization=quant_mode,
+                attn_backend=(
+                    (
+                        'pallas'
+                        if jax.default_backend() == 'tpu'
+                        and model_cfg.head_size % 128 == 0
+                        else 'xla'
+                    )
+                    if config.attn_backend == 'auto'
+                    else config.attn_backend
+                ),
+                # None = inherit EngineConfig's defaults (single owner).
+                **{
+                    knob: value
+                    for knob, value in (
+                        ('decode_steps', config.decode_steps),
+                        ('sampling_top_window', config.sampling_top_window),
+                        ('decode_layer_unroll', config.decode_layer_unroll),
+                    )
+                    if value is not None
+                },
             ),
             mesh=mesh,
             # The generator created these params itself; let the engine
