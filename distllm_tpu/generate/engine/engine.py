@@ -122,9 +122,12 @@ class EngineConfig(BaseConfig):
     # bandwidth bound and the rolled scan's dynamic-slice of stacked MLP
     # kernels is materialized by XLA (~3x HBM traffic on most of the
     # weights — AOT HLO census, scripts/probe_decode_hlo.py); unrolling
-    # folds the slices into the matmuls. Costs one longer compile per
-    # decode shape (amortized by the persistent cache); prefill keeps the
-    # rolled scan either way.
+    # folds the slices into the matmuls. Cold-start cost is REAL: the
+    # unrolled 7B window compiles in ~2-6.5 min per decode shape (AOT,
+    # BENCH_NOTES_r04.md) vs seconds rolled — deployments must seed the
+    # persistent compilation cache (scripts/aot_preflight.py) or accept
+    # minutes of dead chip at first serve. Prefill keeps the rolled scan
+    # either way.
     decode_layer_unroll: bool = True
 
     @field_validator('sampling_top_window')
@@ -276,7 +279,9 @@ class LLMEngine:
             )
 
         self._decode_window = jax.jit(window_fn, donate_argnums=(4, 5))
-        self.telemetry: dict[str, str] = {}
+        # Resolved-at-serve-time values: a config that believes it enabled
+        # the Pallas kernel can otherwise ship 3x slower with no signal.
+        self.telemetry: dict[str, str] = {'attn_backend': attn_backend}
         if (
             self._own_params
             and mesh is None

@@ -82,6 +82,16 @@ class ApiGeneratorConfig(BaseConfig):
     def resolved_provider(self) -> str:
         if self.provider != 'auto':
             return self.provider
+        # A non-default openai_api_base means the user is pointing at an
+        # OpenAI-compatible proxy; honoring it beats rerouting a claude*/
+        # gemini* model name to the vendor endpoint with the wrong wire
+        # format (and ignoring the configured base entirely). Compared
+        # against the field default rather than model_fields_set: a
+        # write_yaml/from_yaml round trip re-passes every default as an
+        # explicit kwarg, which would otherwise flip the route.
+        default_base = type(self).model_fields['openai_api_base'].default
+        if self.openai_api_base.rstrip('/') != default_base.rstrip('/'):
+            return 'openai'
         model = self.model.lower()
         if model.startswith('claude'):
             return 'anthropic'
@@ -164,6 +174,23 @@ class ApiGenerator:
         )
 
     def _parse(self, payload: dict) -> str:
+        # A 200 whose body lacks the provider's expected fields (e.g. a
+        # proxy error JSON) is deterministic — raise ApiResponseError (in
+        # give_up_on) rather than KeyError, which expo_backoff_retry would
+        # re-bill.
+        try:
+            return self._parse_payload(payload)
+        except (KeyError, IndexError, TypeError, AttributeError) as e:
+            shape = (
+                sorted(payload)[:8]
+                if isinstance(payload, dict)
+                else type(payload).__name__
+            )
+            raise ApiResponseError(
+                f'malformed {self.provider} payload ({shape!r}): {e!r}'
+            ) from e
+
+    def _parse_payload(self, payload: dict) -> str:
         if self.provider == 'anthropic':
             return ''.join(
                 block.get('text', '')

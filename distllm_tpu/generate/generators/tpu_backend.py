@@ -85,6 +85,37 @@ class TpuGeneratorConfig(BaseConfig):
 
 
 class TpuGenerator:
+    @staticmethod
+    def _resolve_attn_backend(config: TpuGeneratorConfig, model_cfg) -> str:
+        """Resolve 'auto' to a concrete kernel, loudly.
+
+        Eligibility lives with the kernel (`paged_attention.supported_head_dim`
+        — CI-exercised head dims only, not the kernel's looser structural
+        %128 check), so widening kernel coverage widens 'auto' in one
+        place. When 'auto' lands on XLA despite a TPU being present, log
+        it: the fallback is correct but silently costs ~3x decode, and the
+        resolved value is also surfaced in engine telemetry as
+        ``attn_backend``.
+        """
+        import jax
+
+        from distllm_tpu.ops.paged_attention import supported_head_dim
+
+        if config.attn_backend != 'auto':
+            return config.attn_backend
+        on_tpu = jax.default_backend() == 'tpu'
+        if on_tpu and supported_head_dim(model_cfg.head_size):
+            return 'pallas'
+        if on_tpu:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "attn_backend='auto' resolved to XLA: head_dim %d is "
+                'outside the tested Pallas kernel shapes',
+                model_cfg.head_size,
+            )
+        return 'xla'
+
     def __init__(self, config: TpuGeneratorConfig) -> None:
         import jax
 
@@ -137,16 +168,7 @@ class TpuGenerator:
                 max_num_seqs=config.max_num_seqs,
                 max_model_len=config.max_model_len,
                 quantization=quant_mode,
-                attn_backend=(
-                    (
-                        'pallas'
-                        if jax.default_backend() == 'tpu'
-                        and model_cfg.head_size % 128 == 0
-                        else 'xla'
-                    )
-                    if config.attn_backend == 'auto'
-                    else config.attn_backend
-                ),
+                attn_backend=self._resolve_attn_backend(config, model_cfg),
                 # None = inherit EngineConfig's defaults (single owner).
                 **{
                     knob: value

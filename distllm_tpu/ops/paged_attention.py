@@ -31,6 +31,19 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# Head dims the Pallas kernel is exercised at in CI (tests/test_aot_tpu.py
+# compiles these against a real v5e topology). The kernel's structural
+# requirement is only head_dim % 128 == 0 (Mosaic DMA alignment, checked in
+# paged_attention_pallas), but 'auto' backend selection routes through
+# supported_head_dim so untested shapes never auto-enable the kernel —
+# widen this tuple when a new shape gains AOT coverage.
+TESTED_HEAD_DIMS = (128,)
+
+
+def supported_head_dim(head_dim: int) -> bool:
+    """True when `attn_backend='auto'` may select the Pallas kernel."""
+    return head_dim in TESTED_HEAD_DIMS
+
 
 def paged_attention_xla(
     q: jnp.ndarray,  # [B, num_heads, head_dim]

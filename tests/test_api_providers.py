@@ -179,3 +179,67 @@ def test_google_extra_generation_config_merges(capture):
     assert body['generationConfig']['topP'] == 0.9
     assert body['generationConfig']['maxOutputTokens'] == 512
     assert body['safetySettings'] == [{'category': 'X'}]
+
+
+def test_auto_prefers_openai_when_base_set():
+    # A claude* model pointed at an OpenAI-compatible proxy must use the
+    # configured base with the openai wire, not reroute to api.anthropic.com.
+    cfg = ApiGeneratorConfig(
+        model='claude-3-opus', openai_api_base='http://proxy:8000/v1'
+    )
+    assert cfg.resolved_provider() == 'openai'
+    # Without an explicit base, the name heuristic still applies.
+    assert (
+        ApiGeneratorConfig(model='claude-3-opus').resolved_provider()
+        == 'anthropic'
+    )
+
+
+def test_malformed_payload_not_retried(capture):
+    # A 200 carrying a proxy error body is deterministic: ApiResponseError
+    # (in give_up_on), never a KeyError re-billed by the retry loop.
+    from distllm_tpu.generate.generators.api_backend import ApiResponseError
+
+    capture.payload = {'error': {'message': 'upstream exploded'}}
+    for model in ('gpt-4', 'claude-3-opus'):
+        gen = ApiGenerator(
+            ApiGeneratorConfig(model=model, api_key='k', max_tries=3)
+        )
+        with pytest.raises(ApiResponseError):
+            gen.generate('hi')
+    # max_tries=3 but each model made exactly ONE request (no retries).
+    assert len(capture.calls) == 2
+
+
+def test_non_dict_and_string_block_payloads(capture):
+    # Proxy bodies that are legal JSON but the wrong shape entirely: a
+    # string content block (AttributeError path) and a bare list body.
+    from distllm_tpu.generate.generators.api_backend import ApiResponseError
+
+    capture.payload = {'content': 'upstream error text'}
+    gen = ApiGenerator(
+        ApiGeneratorConfig(model='claude-3-opus', api_key='k', max_tries=3)
+    )
+    with pytest.raises(ApiResponseError):
+        gen.generate('hi')
+
+    capture.payload = [{'error': 'x'}, {'error': 'y'}]
+    gen = ApiGenerator(
+        ApiGeneratorConfig(model='gpt-4', api_key='k', max_tries=3)
+    )
+    with pytest.raises(ApiResponseError):
+        gen.generate('hi')
+    assert len(capture.calls) == 2  # one request each, no re-billing
+
+
+def test_auto_provider_survives_yaml_roundtrip(tmp_path):
+    # write_yaml re-passes every default as an explicit kwarg on reload;
+    # the proxy-base heuristic must compare values, not model_fields_set,
+    # or a round trip silently flips claude* routing to the openai wire.
+    cfg = ApiGeneratorConfig(model='claude-3-opus')
+    assert cfg.resolved_provider() == 'anthropic'
+    path = tmp_path / 'cfg.yaml'
+    cfg.write_yaml(path)
+    assert (
+        ApiGeneratorConfig.from_yaml(path).resolved_provider() == 'anthropic'
+    )

@@ -239,3 +239,35 @@ def test_decoder_family_dispatch():
     assert cfg_cls is mistral.MistralConfig and family is mistral
     with pytest.raises(ValueError, match='Unsupported decoder'):
         decoder_family('bert')
+
+
+def test_attn_backend_auto_resolution(monkeypatch):
+    """'auto' selects Pallas only for the kernel's tested contract
+    (head_dim == 128 exactly, on a TPU); everything else gets XLA."""
+    from types import SimpleNamespace
+
+    import jax
+
+    from distllm_tpu.generate.generators.tpu_backend import (
+        TpuGenerator,
+        TpuGeneratorConfig,
+    )
+
+    resolve = TpuGenerator._resolve_attn_backend
+    cfg = TpuGeneratorConfig(pretrained_model_name_or_path='/x')
+    mc128 = SimpleNamespace(head_size=128)
+    mc256 = SimpleNamespace(head_size=256)
+
+    # CPU backend: always XLA.
+    assert resolve(cfg, mc128) == 'xla'
+
+    monkeypatch.setattr(jax, 'default_backend', lambda: 'tpu')
+    assert resolve(cfg, mc128) == 'pallas'
+    # head_dim 256 is a multiple of 128 but outside the tested contract.
+    assert resolve(cfg, mc256) == 'xla'
+
+    # Explicit settings are never overridden.
+    explicit = TpuGeneratorConfig(
+        pretrained_model_name_or_path='/x', attn_backend='pallas'
+    )
+    assert resolve(explicit, mc256) == 'pallas'
