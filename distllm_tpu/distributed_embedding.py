@@ -17,9 +17,10 @@ import uuid
 from pathlib import Path
 from typing import Any
 
+from distllm_tpu.parallel.fabric import map_with_teardown
 from distllm_tpu.parallel.launcher import ComputeConfigs, LocalConfig
 from distllm_tpu.timer import Timer
-from distllm_tpu.utils import BaseConfig
+from distllm_tpu.utils import BaseConfig, canonical_function
 
 
 def embedding_worker(
@@ -91,7 +92,10 @@ def run_embedding(config: Config) -> int:
     print(f'Embedding {len(files)} files -> {embedding_dir}')
 
     worker_fn = functools.partial(
-        embedding_worker,
+        # Run as `python -m`, this module is __main__; rebind the
+        # worker fn to its importable path so fabric workers can
+        # unpickle it (Parsl has the same module-level-fn rule).
+        canonical_function(embedding_worker, 'distllm_tpu.distributed_embedding'),
         output_dir=str(embedding_dir),
         dataset_kwargs=config.dataset_config,
         encoder_kwargs=config.encoder_config,
@@ -100,7 +104,7 @@ def run_embedding(config: Config) -> int:
         writer_kwargs=config.writer_config,
     )
     executor = config.compute_config.get_executor(config.output_dir / 'run')
-    shards = executor.map(worker_fn, files)
+    shards = map_with_teardown(executor, worker_fn, files)
     print(f'Finished: {len(shards)} shards written')
     return 0
 

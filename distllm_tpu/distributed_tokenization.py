@@ -18,9 +18,10 @@ import uuid
 from pathlib import Path
 from typing import Any
 
+from distllm_tpu.parallel.fabric import map_with_teardown
 from distllm_tpu.parallel.launcher import ComputeConfigs, LocalConfig
 from distllm_tpu.timer import Timer
-from distllm_tpu.utils import BaseConfig
+from distllm_tpu.utils import BaseConfig, canonical_function
 
 
 class TokenizerConfig(BaseConfig):
@@ -103,12 +104,15 @@ def run_tokenization(config: Config) -> int:
     print(f'Tokenizing {len(files)} files -> {dataset_dir}')
 
     worker_fn = functools.partial(
-        tokenizer_worker,
+        # Run as `python -m`, this module is __main__; rebind the
+        # worker fn to its importable path so fabric workers can
+        # unpickle it (Parsl has the same module-level-fn rule).
+        canonical_function(tokenizer_worker, 'distllm_tpu.distributed_tokenization'),
         output_dir=str(dataset_dir),
         tokenizer_kwargs=config.tokenizer_config,
     )
     executor = config.compute_config.get_executor(config.output_dir / 'run')
-    shards = executor.map(worker_fn, files)
+    shards = map_with_teardown(executor, worker_fn, files)
     print(f'Finished: {len(shards)} shards written')
     return 0
 

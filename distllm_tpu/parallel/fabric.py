@@ -31,6 +31,12 @@ from typing import Any, Callable, Iterable
 _READY = b'READY'
 _HEARTBEAT = b'HB'
 _RESULT = b'RESULT'
+# Poison pill: [b'', _SHUTDOWN] ends the worker loop. Needed because a
+# worker that joined the global JAX runtime no longer dies on SIGTERM
+# (jax.distributed installs a preemption notifier that swallows it) —
+# drivers end a run by telling workers to exit, like Parsl's
+# interchange shutdown, instead of relying on signals.
+_SHUTDOWN = b'SHUTDOWN'
 
 
 @dataclass
@@ -108,6 +114,10 @@ class Coordinator:
             ident, kind = frames[0], frames[1]
             worker = self._workers.setdefault(ident, _WorkerState(ident))
             worker.last_seen = time.monotonic()
+            if kind == _HEARTBEAT:
+                # Ack so an idle-but-alive run keeps resetting the workers'
+                # idle_timeout self-destruct (liveness flows both ways).
+                self._socket.send_multipart([ident, b'', _HEARTBEAT])
             if kind == _READY:
                 worker.current = None
             elif kind == _RESULT:
@@ -135,6 +145,35 @@ class Coordinator:
                 self._socket.send_multipart([ident, task.task_id, task.payload])
         return results
 
+    def shutdown_workers(self, drain_seconds: float = 3.0) -> None:
+        """Send every worker the poison pill (graceful pod teardown).
+
+        After pilling the registered set, keeps draining the socket for
+        ``drain_seconds`` and pills any ident that still speaks up: a
+        late-booting host whose READY arrived after ``run`` returned, or a
+        reaped-but-alive worker, would otherwise never get the pill and —
+        since jax_distributed workers swallow SIGTERM — burn walltime.
+        """
+        import zmq
+
+        pilled: set[bytes] = set()
+
+        def pill(ident: bytes) -> None:
+            if ident not in pilled:
+                self._socket.send_multipart([ident, b'', _SHUTDOWN])
+                pilled.add(ident)
+
+        for ident in list(self._workers):
+            pill(ident)
+        poller = zmq.Poller()
+        poller.register(self._socket, zmq.POLLIN)
+        deadline = time.monotonic() + drain_seconds
+        while time.monotonic() < deadline:
+            events = dict(poller.poll(timeout=200))
+            if self._socket in events:
+                pill(self._socket.recv_multipart()[0])
+        self._workers.clear()
+
     def _reap_lost_workers(
         self, in_flight: dict[bytes, _Task], pending: list[_Task]
     ) -> None:
@@ -159,15 +198,29 @@ class FabricWorker:
     thread-safe, so all sends share a lock) and keeps flowing while the main
     thread is blocked inside a long task — the coordinator therefore only
     reaps on real network/process loss.
+
+    ``idle_timeout`` bounds how long the worker survives without hearing
+    ANYTHING from the coordinator (which acks heartbeats while pumping).
+    A straggler host that boots after the driver already exited — or
+    outlives a crashed driver — would otherwise poll a dead endpoint
+    forever, and a worker in the global JAX runtime cannot be SIGTERMed
+    (preemption notifier); this is its self-destruct. Must cover worst-case
+    boot stagger plus any driver dead time between ``map`` calls.
     """
 
-    def __init__(self, coordinator: str, heartbeat_interval: float = 5.0) -> None:
+    def __init__(
+        self,
+        coordinator: str,
+        heartbeat_interval: float = 5.0,
+        idle_timeout: float = 900.0,
+    ) -> None:
         import zmq
 
         self._ctx = zmq.Context.instance()
         self._socket = self._ctx.socket(zmq.DEALER)
         self._socket.connect(coordinator)
         self.heartbeat_interval = heartbeat_interval
+        self.idle_timeout = idle_timeout
         self._stop = threading.Event()
         self._send_lock = threading.Lock()
 
@@ -187,12 +240,23 @@ class FabricWorker:
         poller = zmq.Poller()
         poller.register(self._socket, zmq.POLLIN)
         self._send([_READY])
+        last_contact = time.monotonic()
         while not self._stop.is_set():
             events = dict(poller.poll(timeout=500))
             if self._socket not in events:
+                if time.monotonic() - last_contact > self.idle_timeout:
+                    print(
+                        f'[worker] no coordinator contact for '
+                        f'{self.idle_timeout:.0f}s; exiting',
+                        flush=True,
+                    )
+                    break
                 continue
+            last_contact = time.monotonic()
             task_id, payload = self._socket.recv_multipart()
             if not task_id:
+                if payload == _SHUTDOWN:
+                    break
                 continue
             try:
                 fn, args, kwargs = pickle.loads(payload)
@@ -202,6 +266,7 @@ class FabricWorker:
                 self._send(
                     [_RESULT, task_id, b'0', pickle.dumps(RuntimeError(repr(exc)))]
                 )
+        self._stop.set()  # ends the heartbeat thread on poison-pill exit
 
     def stop(self) -> None:
         self._stop.set()
@@ -212,6 +277,10 @@ class ZmqPoolExecutor:
 
     def __init__(self, coordinator: Coordinator) -> None:
         self.coordinator = coordinator
+
+    def shutdown(self) -> None:
+        """Poison-pill every connected worker (end of the pod run)."""
+        self.coordinator.shutdown_workers()
 
     def map(self, fn: Callable, items: Iterable[Any]) -> list[Any]:
         tasks = []
@@ -230,3 +299,18 @@ class ZmqPoolExecutor:
                 raise value
             out.append(value)
         return out
+
+
+def map_with_teardown(executor, fn: Callable, items: Iterable[Any]) -> list[Any]:
+    """``executor.map`` that ALWAYS shuts the pool down afterwards.
+
+    The drivers' single entry to a pool: pod workers that joined the global
+    JAX runtime ignore SIGTERM (preemption notifier), so they must receive
+    the poison pill even when a task exhausts its retries and ``map``
+    raises — otherwise a failed run leaves the worker job burning its full
+    walltime. In-process executors have no ``shutdown`` and pass through.
+    """
+    try:
+        return executor.map(fn, items)
+    finally:
+        getattr(executor, 'shutdown', lambda: None)()

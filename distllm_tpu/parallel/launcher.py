@@ -140,12 +140,27 @@ class _BatchSchedulerConfig(BaseConfig):
     retries: int = 1
     heartbeat_threshold: float = 120.0
     submit: bool = True
+    jax_distributed: bool = Field(
+        default=False,
+        description='Join every pod host into ONE global JAX runtime '
+        '(multi-host mesh over DCN) instead of independent per-host '
+        'processes; the job script exports DISTLLM_JAX_* and the worker '
+        'calls jax.distributed.initialize (parallel/multihost.py).',
+    )
+    jax_coordinator_port: int = Field(
+        default=8476,
+        description='Port the first pod host serves the JAX coordination '
+        'service on (jax_distributed only).',
+    )
 
     def _worker_command(self, endpoint: str) -> str:
-        return (
+        cmd = (
             'python -m distllm_tpu.parallel.worker '
             f'--coordinator {endpoint}'
         )
+        if self.jax_distributed:
+            cmd += ' --jax-distributed'
+        return cmd
 
     def render_script(self, endpoint: str, run_dir: Path) -> str:
         raise NotImplementedError
@@ -219,12 +234,20 @@ class TpuPodPbsConfig(_BatchSchedulerConfig):
         ]
         if self.scheduler_options:
             lines.extend(self.scheduler_options.splitlines())
+        lines += ['', self.worker_init, '']
+        if self.jax_distributed:
+            lines += [
+                '# Global JAX runtime: first pod host runs the coordination',
+                '# service; per-rank process id comes from PMI_RANK/',
+                '# PALS_RANKID (read by parallel/multihost.py).',
+                'export DISTLLM_JAX_COORDINATOR='
+                f'"$(head -n1 "$PBS_NODEFILE"):{self.jax_coordinator_port}"',
+                f'export DISTLLM_JAX_NUM_PROCESSES={self.num_nodes}',
+                '',
+            ]
         lines += [
-            '',
-            self.worker_init,
-            '',
             '# One fabric worker per pod host, dialing the coordinator.',
-            f'mpiexec -n {self.num_nodes} --ppn 1 '
+            f'mpiexec -n {self.num_nodes} --ppn 1 --envall '
             + self._worker_command(endpoint),
             '',
         ]
@@ -264,10 +287,19 @@ class TpuPodSlurmConfig(_BatchSchedulerConfig):
             lines.append(f'#SBATCH --qos={self.qos}')
         if self.scheduler_options:
             lines.extend(self.scheduler_options.splitlines())
+        lines += ['', self.worker_init, '']
+        if self.jax_distributed:
+            lines += [
+                '# Global JAX runtime: first pod host runs the coordination',
+                '# service; per-rank process id comes from SLURM_PROCID',
+                '# (read by parallel/multihost.py).',
+                'export DISTLLM_JAX_COORDINATOR='
+                '"$(scontrol show hostnames "$SLURM_JOB_NODELIST" '
+                f'| head -n1):{self.jax_coordinator_port}"',
+                f'export DISTLLM_JAX_NUM_PROCESSES={self.num_nodes}',
+                '',
+            ]
         lines += [
-            '',
-            self.worker_init,
-            '',
             '# One fabric worker per pod host, dialing the coordinator.',
             f'srun --ntasks={self.num_nodes} --ntasks-per-node=1 '
             + self._worker_command(endpoint),

@@ -1,0 +1,86 @@
+"""Multi-host JAX runtime initialization (the DCN control plane).
+
+Reference parity: ``distllm/parsl.py:172-252`` — the reference's multi-node
+substrate is Parsl HTEX (one manager per node, interchange on the login
+node); the NCCL data plane lives inside vLLM. Here the data plane is XLA
+collectives over ICI/DCN, and the control plane that stitches per-host JAX
+processes into ONE global device view is ``jax.distributed.initialize`` —
+this module owns that call so the pod worker, launcher scripts, and tests
+initialize identically.
+
+Topology sources, in precedence order:
+
+1. Explicit arguments (tests, ad-hoc two-process runs).
+2. ``DISTLLM_JAX_COORDINATOR`` / ``DISTLLM_JAX_NUM_PROCESSES`` /
+   ``DISTLLM_JAX_PROCESS_ID`` environment variables — what the rendered
+   PBS/Slurm pod scripts export per host (process id falls back to the
+   scheduler rank: ``SLURM_PROCID`` or ``PMI_RANK``).
+3. JAX's own cluster auto-detection (TPU pod metadata, Slurm) when nothing
+   is specified at all.
+
+On CPU the cross-process backend is Gloo, which is what lets CI exercise
+this exact code path with two local processes (tests/test_multihost.py)
+without TPU pod hardware.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENV_COORD = 'DISTLLM_JAX_COORDINATOR'
+_ENV_NPROC = 'DISTLLM_JAX_NUM_PROCESSES'
+_ENV_PID = 'DISTLLM_JAX_PROCESS_ID'
+# Scheduler ranks, in the order the pod launchers start workers.
+_RANK_ENVS = (_ENV_PID, 'SLURM_PROCID', 'PMI_RANK', 'PALS_RANKID')
+
+
+def _env_rank() -> int | None:
+    for var in _RANK_ENVS:
+        value = os.environ.get(var)
+        if value is not None:
+            return int(value)
+    return None
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> tuple[int, int]:
+    """Join this process to the global JAX runtime; returns (rank, size).
+
+    Idempotent: a second call (e.g. worker restart inside one process)
+    returns the existing topology instead of re-initializing. With no
+    arguments and no ``DISTLLM_JAX_*`` environment, defers to JAX's
+    cluster auto-detection (TPU pod / Slurm).
+    """
+    import jax
+
+    if jax.distributed.is_initialized():
+        return jax.process_index(), jax.process_count()
+
+    coordinator_address = coordinator_address or os.environ.get(_ENV_COORD)
+    if num_processes is None and os.environ.get(_ENV_NPROC):
+        num_processes = int(os.environ[_ENV_NPROC])
+    if process_id is None:
+        process_id = _env_rank()
+
+    kwargs: dict = {}
+    if coordinator_address is not None:
+        # jax.distributed wants host:port; tolerate the fabric's tcp:// form.
+        kwargs['coordinator_address'] = coordinator_address.removeprefix(
+            'tcp://'
+        )
+    if num_processes is not None:
+        kwargs['num_processes'] = num_processes
+    if process_id is not None:
+        kwargs['process_id'] = process_id
+    jax.distributed.initialize(**kwargs)
+    return jax.process_index(), jax.process_count()
+
+
+def process_rank() -> tuple[int, int]:
+    """(process_index, process_count) of the current global runtime."""
+    import jax
+
+    return jax.process_index(), jax.process_count()

@@ -14,7 +14,7 @@ import os
 import re
 import subprocess
 from pathlib import Path
-from typing import Any, Iterator, TypeVar
+from typing import Any, Callable, Iterator, TypeVar
 
 import yaml
 from pydantic import BaseModel, ConfigDict
@@ -182,6 +182,19 @@ def curl_download(url: str, output_path: PathLike, timeout: int = 600) -> Path:
     )
     tmp_path.rename(output_path)
     return output_path
+
+
+def canonical_function(fn: Callable, module: str) -> Callable:
+    """Re-resolve ``fn`` from its importable module when it was defined in
+    ``__main__`` (a driver run as ``python -m ...``). Pickle serializes
+    functions by module path, and ``__main__`` inside a fabric worker is
+    ``distllm_tpu.parallel.worker`` — the worker could never resolve the
+    driver's function without this."""
+    if getattr(fn, '__module__', None) != '__main__':
+        return fn
+    import importlib
+
+    return getattr(importlib.import_module(module), fn.__name__)
 
 
 def expo_backoff_retry(

@@ -229,3 +229,22 @@ def test_apply_platform_env_honors_env(monkeypatch):
         assert jax.config.jax_platforms == 'cpu'
     finally:
         jax.config.update('jax_platforms', before)
+
+
+def test_canonical_function_rebinds_main():
+    from distllm_tpu.utils import batch_data, canonical_function
+
+    # Functions already owned by an importable module pass through.
+    assert canonical_function(batch_data, 'distllm_tpu.utils') is batch_data
+
+    # A __main__-defined function (driver run via `python -m`) is re-resolved
+    # from its canonical module so fabric workers can unpickle it.
+    import types
+
+    fake_main = types.FunctionType(
+        batch_data.__code__, batch_data.__globals__, 'batch_data'
+    )
+    fake_main.__module__ = '__main__'
+    assert (
+        canonical_function(fake_main, 'distllm_tpu.utils') is batch_data
+    )

@@ -128,9 +128,45 @@ def test_pbs_script_renders():
     assert '#PBS -l filesystems=home:data' in script
     assert 'source /opt/venv/bin/activate' in script
     assert (
-        'mpiexec -n 256 --ppn 1 python -m distllm_tpu.parallel.worker '
-        '--coordinator tcp://driver:5555' in script
+        'mpiexec -n 256 --ppn 1 --envall python -m '
+        'distllm_tpu.parallel.worker --coordinator tcp://driver:5555'
+        in script
     )
+    # Default: independent per-host JAX processes, no global runtime env.
+    assert 'DISTLLM_JAX_COORDINATOR' not in script
+
+
+def test_pbs_script_renders_jax_distributed():
+    from distllm_tpu.parallel.launcher import TpuPodPbsConfig
+
+    compute = TpuPodPbsConfig(
+        account='acct', queue='q', num_nodes=4, jax_distributed=True,
+        jax_coordinator_port=8123, submit=False,
+    )
+    script = compute.render_script('tcp://driver:5555', Path('/tmp/run'))
+    assert (
+        'export DISTLLM_JAX_COORDINATOR='
+        '"$(head -n1 "$PBS_NODEFILE"):8123"' in script
+    )
+    assert 'export DISTLLM_JAX_NUM_PROCESSES=4' in script
+    assert '--jax-distributed' in script
+
+
+def test_sbatch_script_renders_jax_distributed():
+    from distllm_tpu.parallel.launcher import TpuPodSlurmConfig
+
+    compute = TpuPodSlurmConfig(
+        account='acct', queue='q', num_nodes=8, jax_distributed=True,
+        submit=False,
+    )
+    script = compute.render_script('tcp://driver:5555', Path('/tmp/run'))
+    assert (
+        'export DISTLLM_JAX_COORDINATOR='
+        '"$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1):8476"'
+        in script
+    )
+    assert 'export DISTLLM_JAX_NUM_PROCESSES=8' in script
+    assert '--jax-distributed' in script
 
 
 def test_sbatch_script_renders():
@@ -225,3 +261,32 @@ def test_protein_search_example_runs(tmp_path):
     # semantics), so up to top_k survive.
     assert all(1 <= len(line['hits']) <= 3 for line in lines)
     assert all('tag' in h and 'score' in h for h in lines[0]['hits'])
+
+
+def test_scaling_ladder_constructs():
+    """Every rung of the 2/16/64/256 scaling ladder (reference parity:
+    examples/scaling/polaris/*/nodes*.yaml) loads, carries the right node
+    count, and renders a submittable job script."""
+    from distllm_tpu.distributed_embedding import Config as EmbedConfig
+    from distllm_tpu.distributed_generation import Config as GenConfig
+
+    ladder = EXAMPLES / 'pod' / 'scaling'
+    rungs = (2, 16, 64, 256)
+    for n in rungs:
+        embed = EmbedConfig.from_yaml(ladder / 'embed' / f'nodes{n:03d}.yaml')
+        assert embed.compute_config.num_nodes == n
+        script = embed.compute_config.render_script(
+            'tcp://driver:5555', Path('/tmp/run')
+        )
+        assert f'mpiexec -n {n} ' in script
+
+        gen = GenConfig.from_yaml(ladder / 'generate' / f'nodes{n:03d}.yaml')
+        assert gen.compute_config.num_nodes == n
+        script = gen.compute_config.render_script(
+            'tcp://driver:5555', Path('/tmp/run')
+        )
+        assert f'srun --ntasks={n} ' in script
+    # The ladder is complete: no stray rungs, embed and generate in step.
+    for pipeline in ('embed', 'generate'):
+        files = sorted(p.name for p in (ladder / pipeline).glob('*.yaml'))
+        assert files == [f'nodes{n:03d}.yaml' for n in rungs]

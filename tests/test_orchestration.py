@@ -2,6 +2,7 @@
 
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -225,3 +226,51 @@ def test_cli_chunk_fasta(tmp_path):
 
     total = sum(len(read_fasta(c)) for c in chunks)
     assert total == 10
+
+
+def test_fabric_worker_idle_self_destruct():
+    """A worker that never hears from a coordinator (straggler host booting
+    after the driver exited) exits on its own — it cannot rely on SIGTERM
+    once it joined the global JAX runtime (preemption notifier)."""
+    pytest.importorskip('zmq')
+    from distllm_tpu.parallel.fabric import FabricWorker
+
+    # Endpoint nobody listens on.
+    worker = FabricWorker(
+        'tcp://127.0.0.1:1', heartbeat_interval=0.2, idle_timeout=1.5
+    )
+    thread = threading.Thread(target=worker.run, daemon=True)
+    start = time.monotonic()
+    thread.start()
+    thread.join(timeout=15)
+    assert not thread.is_alive(), 'worker did not self-destruct'
+    assert time.monotonic() - start >= 1.5
+
+
+def test_fabric_poison_pill_and_heartbeat_acks():
+    """Graceful shutdown ends worker loops without signals, and coordinator
+    heartbeat acks keep a live worker's idle clock fresh while it waits."""
+    pytest.importorskip('zmq')
+    from distllm_tpu.parallel.fabric import (
+        Coordinator,
+        FabricWorker,
+        ZmqPoolExecutor,
+    )
+
+    coordinator = Coordinator(bind='tcp://*:0', retries=0)
+    # idle_timeout shorter than the run: only the coordinator's HB acks
+    # (sent while pumping) keep the worker alive until the pill arrives.
+    worker = FabricWorker(
+        coordinator.endpoint, heartbeat_interval=0.2, idle_timeout=2.0
+    )
+    thread = threading.Thread(target=worker.run, daemon=True)
+    thread.start()
+    try:
+        executor = ZmqPoolExecutor(coordinator)
+        assert executor.map(_work, ['x']) == ['done-x']
+        executor.shutdown()
+        thread.join(timeout=10)
+        assert not thread.is_alive(), 'poison pill did not stop the worker'
+    finally:
+        worker.stop()
+        coordinator.close()
