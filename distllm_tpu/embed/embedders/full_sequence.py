@@ -35,6 +35,7 @@ def compute_embeddings(
     normalize: bool = False,
     flush_every: int = 64,
     max_resident_groups: int = 8,
+    tokenize_ahead: int = 2,
     stats: dict | None = None,
 ) -> np.ndarray:
     """Embed ``texts`` → host ``[N, H]`` float32 array in original order.
@@ -48,6 +49,13 @@ def compute_embeddings(
     sealed groups stay on device: past that the oldest (whose async copy has
     had the longest to land) is drained into the host buffer, so device
     residency stays O(flush_every · batch · H) rather than O(corpus).
+
+    ``tokenize_ahead`` batches are tokenized on a background thread while
+    the main thread dispatches: dispatch itself is ~free (async), so the
+    device only starves when HOST tokenization of the next batch outlasts
+    device compute of the current one — true for heavy HF tokenizers on
+    long chunks (fast tokenizers release the GIL, so the overlap is real).
+    ``0`` restores inline tokenization.
 
     ``stats``, when given, is filled with bucket-occupancy telemetry:
     ``tokens_real`` / ``tokens_padded`` (device token slots incl. padding)
@@ -97,38 +105,77 @@ def compute_embeddings(
         while len(groups) > max_resident_groups:
             drain_group()
 
-    for lo in range(0, n, batch_size):
+    def tokenize(lo: int):
         idx = order[lo : lo + batch_size]
         batch = encoder.tokenizer([texts[i] for i in idx])
-        batch = batch.pad_batch_to(batch_size, pad_id=encoder.tokenizer.pad_id)
-        if stats is not None:
-            stats['tokens_real'] = stats.get('tokens_real', 0) + int(
-                batch.attention_mask.sum()
-            )
-            stats['tokens_padded'] = (
-                stats.get('tokens_padded', 0) + batch.input_ids.size
-            )
-            hist = stats.setdefault('bucket_batches', {})
-            bucket = int(batch.input_ids.shape[1])
-            hist[bucket] = hist.get(bucket, 0) + 1
-        if fused is not None:
-            pooled = fused(batch)
-        else:
-            pooled = pooler.pool(encoder.forward(batch), batch.attention_mask)
-            if normalize:
-                # Same guarded normalize as the fused path (zero vectors from
-                # fully-masked pad rows must not produce NaN).
-                pooled = pooled / jnp.clip(
-                    jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+        return idx, batch.pad_batch_to(
+            batch_size, pad_id=encoder.tokenizer.pad_id
+        )
+
+    starts = list(range(0, n, batch_size))
+    if tokenize_ahead > 0 and len(starts) > 1:
+        batches = _prefetched(tokenize, starts, tokenize_ahead)
+    else:
+        batches = (tokenize(s) for s in starts)
+
+    # try/finally around the consumer loop: deterministically finalize the
+    # prefetch generator (its own finally stops the tokenizer thread) even
+    # when the loop raises, e.g. an encoder OOM — GC finalization can be
+    # arbitrarily deferred while the exception's traceback pins this frame.
+    try:
+        for idx, batch in batches:
+            if stats is not None:
+                stats['tokens_real'] = stats.get('tokens_real', 0) + int(
+                    batch.attention_mask.sum()
                 )
-            pooled = pooled.astype(jnp.float32)
-        pending.append((idx, pooled))
-        if len(pending) >= flush_every:
-            seal_group()
+                stats['tokens_padded'] = (
+                    stats.get('tokens_padded', 0) + batch.input_ids.size
+                )
+                hist = stats.setdefault('bucket_batches', {})
+                bucket = int(batch.input_ids.shape[1])
+                hist[bucket] = hist.get(bucket, 0) + 1
+            if fused is not None:
+                pooled = fused(batch)
+            else:
+                pooled = pooler.pool(
+                    encoder.forward(batch), batch.attention_mask
+                )
+                if normalize:
+                    # Same guarded normalize as the fused path (zero vectors
+                    # from fully-masked pad rows must not produce NaN).
+                    pooled = pooled / jnp.clip(
+                        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+                    )
+                pooled = pooled.astype(jnp.float32)
+            pending.append((idx, pooled))
+            if len(pending) >= flush_every:
+                seal_group()
+    finally:
+        batches.close()
     seal_group()
     while groups:
         drain_group()
     return out
+
+
+def _prefetched(tokenize, starts, depth):
+    """Yield tokenized batches in order, keeping ``depth`` submissions in
+    flight on one background thread. Owns the pool: created on first
+    iteration, shut down in the generator's ``finally`` — which the caller
+    triggers deterministically via ``close()`` on error."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=1)
+    try:
+        # Bounded lookahead: at most `depth` tokenized batches wait in
+        # flight, keeping host memory O(depth · batch · seq).
+        window = [pool.submit(tokenize, s) for s in starts[:depth]]
+        for i, _ in enumerate(starts):
+            if i + depth < len(starts):
+                window.append(pool.submit(tokenize, starts[i + depth]))
+            yield window.pop(0).result()
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
 
 
 class FullSequenceEmbedderConfig(BaseConfig):

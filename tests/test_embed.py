@@ -223,3 +223,23 @@ def test_encoder_warmstart_registry():
     e3 = get_encoder({'name': 'fake', 'embedding_size': 16}, register=True)
     assert e3 is not e1
     registry().clear()
+
+
+def test_tokenize_ahead_matches_inline():
+    """Background-thread tokenize-ahead must be a pure perf knob: same
+    embeddings, same order, any depth."""
+    import numpy as np
+
+    from distllm_tpu.embed import get_encoder, get_pooler
+    from distllm_tpu.embed.embedders.full_sequence import compute_embeddings
+
+    encoder = get_encoder({'name': 'fake', 'embedding_size': 16})
+    pooler = get_pooler({'name': 'mean'})
+    texts = [f'doc {i} ' + 'tok ' * (3 + (i * 7) % 40) for i in range(23)]
+
+    base = compute_embeddings(texts, encoder, pooler, 4, tokenize_ahead=0)
+    for depth in (1, 2, 5):
+        ahead = compute_embeddings(
+            texts, encoder, pooler, 4, tokenize_ahead=depth
+        )
+        np.testing.assert_array_equal(base, ahead)
