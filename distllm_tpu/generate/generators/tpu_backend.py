@@ -84,27 +84,46 @@ class TpuGeneratorConfig(BaseConfig):
         return self
 
 
+def _generation_config_eos(model_dir: str) -> tuple[int, ...]:
+    """ALL ``eos_token_id`` values from the checkpoint's
+    generation_config.json (int or list — vLLM honors every entry, e.g.
+    gemma-2-it stops on both <eos> and <end_of_turn>). Empty tuple on a
+    missing/malformed file — startup must fall back, never crash."""
+    import json
+    from pathlib import Path
+
+    path = Path(model_dir) / 'generation_config.json'
+    if not path.exists():
+        return ()
+    try:
+        eos = json.loads(path.read_text()).get('eos_token_id')
+        ids = eos if isinstance(eos, list) else [eos]
+        return tuple(int(i) for i in ids if i is not None)
+    except (OSError, ValueError, TypeError, AttributeError):
+        return ()
+
+
 class TpuGenerator:
     @staticmethod
     def _resolve_attn_backend(config: TpuGeneratorConfig, model_cfg) -> str:
         """Resolve 'auto' to a concrete kernel, loudly.
 
-        Eligibility lives with the kernel (`paged_attention.supported_head_dim`
-        — CI-exercised head dims only, not the kernel's looser structural
-        %128 check), so widening kernel coverage widens 'auto' in one
-        place. When 'auto' lands on XLA despite a TPU being present, log
+        Eligibility lives with the kernel (`paged_attention.supports_model`
+        — CI-exercised head dims only plus feature support: no softcap /
+        per-layer windows), so widening kernel coverage widens 'auto' in
+        one place. When 'auto' lands on XLA despite a TPU being present, log
         it: the fallback is correct but silently costs ~3x decode, and the
         resolved value is also surfaced in engine telemetry as
         ``attn_backend``.
         """
         import jax
 
-        from distllm_tpu.ops.paged_attention import supported_head_dim
+        from distllm_tpu.ops.paged_attention import supports_model
 
         if config.attn_backend != 'auto':
             return config.attn_backend
         on_tpu = jax.default_backend() == 'tpu'
-        if on_tpu and supported_head_dim(model_cfg.head_size):
+        if on_tpu and supports_model(model_cfg):
             return 'pallas'
         if on_tpu:
             import logging
@@ -156,8 +175,17 @@ class TpuGenerator:
             config.tokenizer_name or config.pretrained_model_name_or_path,
             trust_remote_code=config.trust_remote_code,
         )
+        # vLLM parity: checkpoints commonly carry EOS (or EXTRA stop ids
+        # like gemma-2-it's <end_of_turn>) only in generation_config.json;
+        # honoring just the tokenizer's eos would generate to max_tokens.
+        gc_eos = _generation_config_eos(config.pretrained_model_name_or_path)
         if getattr(tokenizer._tok, 'eos_token_id', None) is not None:
             tokenizer.eos_id = int(tokenizer._tok.eos_token_id)
+        elif gc_eos:
+            tokenizer.eos_id = gc_eos[0]
+        self._extra_stop_ids = tuple(
+            i for i in gc_eos if i != getattr(tokenizer, 'eos_id', None)
+        )
         self.engine = LLMEngine(
             model_cfg,
             params,
@@ -192,6 +220,9 @@ class TpuGenerator:
             top_p=self.config.top_p or 1.0,
             min_p=self.config.min_p,
             max_tokens=self.config.max_tokens,
+            # generation_config stop ids beyond the primary EOS
+            # (gemma-2-it's <end_of_turn>): every entry terminates.
+            stop_token_ids=self._extra_stop_ids,
         )
 
     def generate(self, prompts: str | list[str]) -> list[str]:

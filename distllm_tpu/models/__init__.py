@@ -20,13 +20,15 @@ def decoder_families() -> dict:
     from these rows plus the encoder-only families
     (``embed/encoders/auto.py``) — a new decoder lands in one place.
     """
-    from distllm_tpu.models import mistral, mixtral
+    from distllm_tpu.models import gemma, mistral, mixtral
 
     return {
         'mistral': (mistral.MistralConfig, mistral),
         'llama': (mistral.MistralConfig, mistral),
         'qwen2': (mistral.MistralConfig, mistral),
         'mixtral': (mixtral.MixtralConfig, mixtral),
+        'gemma': (gemma.GemmaConfig, gemma),
+        'gemma2': (gemma.GemmaConfig, gemma),
     }
 
 

@@ -34,12 +34,20 @@ def layer_norm(
     return out
 
 
-def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray, scale: jnp.ndarray, eps: float, plus_one: bool = False
+) -> jnp.ndarray:
     # Norm statistics in fp32 for bf16 activations (standard TPU practice).
+    # ``plus_one``: the Gemma-family ``(1 + w)`` parameterization — the
+    # checkpoint stores zero-centered weights and the forward adds 1
+    # (HF ``GemmaRMSNorm``), so loaded weights stay byte-identical to HF.
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-    return (normed * scale.astype(jnp.float32)).astype(dtype)
+    w = scale.astype(jnp.float32)
+    if plus_one:
+        w = 1.0 + w
+    return (normed * w).astype(dtype)
 
 
 def dense(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None) -> jnp.ndarray:
@@ -65,6 +73,12 @@ def gelu(x: jnp.ndarray) -> jnp.ndarray:
 
 def silu(x: jnp.ndarray) -> jnp.ndarray:
     return jax.nn.silu(x)
+
+
+def softcap(x: jnp.ndarray, cap: float) -> jnp.ndarray:
+    """Gemma-2 logit soft-capping: ``tanh(x/cap)*cap`` (one home for the
+    formula; used on attention scores and final logits)."""
+    return jnp.tanh(x / cap) * cap
 
 
 ACTIVATIONS: dict[str, Callable] = {
@@ -95,18 +109,46 @@ def sdpa(
     mask: jnp.ndarray | None = None,
     is_causal: bool = False,
     scale: float | None = None,
+    logit_softcap: float | None = None,
 ) -> jnp.ndarray:
     """Scaled dot-product attention over ``[B, S, N, H]`` tensors.
 
     ``mask`` is a boolean ``[B, S_kv]`` key-validity mask (attention-mask
     semantics of the embed pipeline) or a broadcastable full
     ``[B, N, S_q, S_kv]`` boolean mask.
+
+    ``logit_softcap`` (Gemma-2) applies ``tanh(s/cap)*cap`` to the scaled
+    scores before masking; ``jax.nn.dot_product_attention`` has no such
+    hook, so that path is an explicit einsum — XLA still fuses it, it just
+    skips the flash-style kernel (acceptable: softcap models also need
+    per-layer masks that the fused path cannot express).
     """
     if mask is not None and mask.ndim == 2:
         mask = mask[:, None, None, :].astype(bool)
-    return jax.nn.dot_product_attention(
-        q, k, v, mask=mask, is_causal=is_causal, scale=scale
-    )
+    if logit_softcap is None:
+        return jax.nn.dot_product_attention(
+            q, k, v, mask=mask, is_causal=is_causal, scale=scale
+        )
+    assert not is_causal, 'softcap path expects an explicit mask'
+    if k.shape[2] != q.shape[2]:  # GQA: expand KV heads to match q
+        k = repeat_kv(k, q.shape[2] // k.shape[2])
+        v = repeat_kv(v, q.shape[2] // v.shape[2])
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    # [B, S, N, H] -> scores [B, N, Sq, Skv] in fp32.
+    scores = jnp.einsum(
+        'bqnh,bknh->bnqk', q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    scores = softcap(scores, logit_softcap)
+    if mask is not None:
+        # Large-finite mask, not -inf (same trick as
+        # jax.nn.dot_product_attention): a fully-masked PADDED query row
+        # would softmax to NaN, and that row's NaN V then poisons every
+        # valid query downstream through exact-zero x NaN products.
+        scores = jnp.where(mask, scores, jnp.float32(-0.7 * 3.4e38))
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bnqk,bknh->bqnh', probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 def rope_frequencies(

@@ -1,0 +1,139 @@
+"""Gemma / Gemma-2 family decoders over the shared Mistral-family forward.
+
+The reference serves whatever vLLM supports; Gemma is the canonical
+TPU-native open-weights family, so it is first-class here (beyond the
+reference's own model list, like Mixtral — SURVEY.md §2.3). One forward
+implementation serves every family (``models/mistral.py`` — the anti-drift
+design the engine relies on); Gemma lands as config knobs there:
+
+- GeGLU MLP (``activation='gelu_new'``, HF ``gelu_pytorch_tanh``);
+- embeddings scaled by sqrt(hidden) cast to the compute dtype;
+- ``(1 + w)`` RMSNorm parameterization (weights stay HF-byte-identical);
+- Gemma-2 additionally: sandwich norms around attention and MLP
+  (``post_norms``), attention/final logit softcapping,
+  ``query_pre_attn_scalar`` score scaling, and the alternating
+  local/global sliding-window pattern (even layers windowed).
+
+Serving: Gemma-2's softcap + per-layer windows are outside the Pallas
+paged-attention kernel's contract, so backend 'auto' resolves to the XLA
+path for them (``ops/paged_attention.supports_model``).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import numpy as np
+
+from distllm_tpu.models import common, mistral
+from distllm_tpu.models.mistral import MistralConfig
+
+
+class GemmaConfig(MistralConfig):
+    name: Literal['gemma', 'gemma2'] = 'gemma'  # type: ignore[assignment]
+
+    @classmethod
+    def from_hf_config(cls, hf: dict) -> 'GemmaConfig':
+        """Map an HF ``GemmaConfig``/``Gemma2Config`` dict.
+
+        HF quirks handled: ``hidden_activation`` (gemma2) vs
+        ``hidden_act`` (gemma), both ``gelu_pytorch_tanh``; ``head_dim``
+        is explicit (256 for most sizes); embeddings are always tied.
+        """
+        model_type = hf.get('model_type', 'gemma')
+        is_v2 = model_type == 'gemma2'
+        act = hf.get('hidden_activation') or hf.get('hidden_act', 'gelu_pytorch_tanh')
+        query_pre_attn = hf.get('query_pre_attn_scalar')
+        return cls(
+            name=model_type,
+            vocab_size=hf['vocab_size'],
+            hidden_size=hf['hidden_size'],
+            num_layers=hf['num_hidden_layers'],
+            num_heads=hf['num_attention_heads'],
+            num_kv_heads=hf.get('num_key_value_heads', hf['num_attention_heads']),
+            head_dim=hf.get('head_dim'),
+            intermediate_size=hf['intermediate_size'],
+            max_position_embeddings=hf.get('max_position_embeddings', 8192),
+            rope_theta=hf.get('rope_theta', 10000.0),
+            rms_norm_eps=hf.get('rms_norm_eps', 1e-6),
+            tie_word_embeddings=hf.get('tie_word_embeddings', True),
+            activation={
+                'gelu_pytorch_tanh': 'gelu_new',
+                'gelu': 'gelu_new',  # HF Gemma aliases plain gelu to tanh
+            }.get(act, act),
+            embedding_multiplier=hf['hidden_size'] ** 0.5,
+            norm_plus_one=True,
+            post_norms=is_v2,
+            query_scale=(
+                query_pre_attn ** -0.5 if is_v2 and query_pre_attn else None
+            ),
+            attn_logit_softcap=hf.get('attn_logit_softcapping') if is_v2 else None,
+            final_logit_softcap=hf.get('final_logit_softcapping') if is_v2 else None,
+            sliding_window=hf.get('sliding_window') if is_v2 else None,
+            sliding_window_pattern='alternating' if is_v2 else 'all',
+        )
+
+
+# The engine and embed pipeline drive families through these entry points;
+# Gemma's behavior differences live entirely in the config knobs above.
+init = mistral.init
+init_on_device = mistral.init_on_device
+apply = mistral.apply
+logits = mistral.logits
+prefill = mistral.prefill
+decode_step = mistral.decode_step
+decode_loop = mistral.decode_loop
+param_specs = mistral.param_specs
+
+
+def params_from_hf(state: dict[str, np.ndarray], cfg: GemmaConfig) -> dict:
+    """Convert HF ``GemmaForCausalLM`` / ``Gemma2ForCausalLM`` weights.
+
+    Norm-name mapping (the trap is ``post_attention_layernorm``):
+
+    - gemma:  ``input_layernorm`` → ``attn_ln``,
+      ``post_attention_layernorm`` → ``mlp_ln`` (the standard Llama
+      pre-MLP meaning);
+    - gemma2: ``input_layernorm`` → ``attn_ln``,
+      ``post_attention_layernorm`` → ``post_attn_ln`` (a TRUE post-norm),
+      ``pre_feedforward_layernorm`` → ``mlp_ln``,
+      ``post_feedforward_layernorm`` → ``post_mlp_ln``.
+    """
+    sd = {k.removeprefix('model.'): v for k, v in state.items()}
+
+    def lin(key):
+        return {'kernel': np.ascontiguousarray(sd[key].T)}
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f'layers.{i}'
+        lp = {
+            'q': lin(f'{p}.self_attn.q_proj.weight'),
+            'k': lin(f'{p}.self_attn.k_proj.weight'),
+            'v': lin(f'{p}.self_attn.v_proj.weight'),
+            'o': lin(f'{p}.self_attn.o_proj.weight'),
+            'attn_ln': {'scale': sd[f'{p}.input_layernorm.weight']},
+            'gate': lin(f'{p}.mlp.gate_proj.weight'),
+            'up': lin(f'{p}.mlp.up_proj.weight'),
+            'down': lin(f'{p}.mlp.down_proj.weight'),
+        }
+        if cfg.post_norms:
+            lp['post_attn_ln'] = {
+                'scale': sd[f'{p}.post_attention_layernorm.weight']
+            }
+            lp['mlp_ln'] = {
+                'scale': sd[f'{p}.pre_feedforward_layernorm.weight']
+            }
+            lp['post_mlp_ln'] = {
+                'scale': sd[f'{p}.post_feedforward_layernorm.weight']
+            }
+        else:
+            lp['mlp_ln'] = {
+                'scale': sd[f'{p}.post_attention_layernorm.weight']
+            }
+        layers.append(lp)
+    return {
+        'embed': sd['embed_tokens.weight'],
+        'layers': common.stack_layers(layers),
+        'final_ln': {'scale': sd['norm.weight']},
+    }

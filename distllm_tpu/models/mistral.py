@@ -48,6 +48,18 @@ class MistralConfig(BaseConfig):
     # Qwen2-family checkpoints (same architecture + Q/K/V projection
     # biases; HF Qwen2Model always has them, MistralModel never does).
     attention_bias: bool = False
+    # --- Gemma-family knobs (models/gemma.py sets these; defaults keep
+    # every existing family bit-identical). ---
+    activation: str = 'silu'  # MLP gate activation (gemma: 'gelu_new')
+    embedding_multiplier: float | None = None  # gemma: sqrt(hidden_size)
+    norm_plus_one: bool = False  # gemma RMSNorm computes (1 + w)
+    post_norms: bool = False  # gemma2 sandwich norms around attn + MLP
+    query_scale: float | None = None  # gemma2 query_pre_attn_scalar**-0.5
+    attn_logit_softcap: float | None = None  # gemma2 tanh cap on scores
+    final_logit_softcap: float | None = None  # gemma2 tanh cap on logits
+    # 'all' = every layer uses cfg.sliding_window (Mistral semantics);
+    # 'alternating' = gemma2's even-layer-local / odd-layer-global split.
+    sliding_window_pattern: Literal['all', 'alternating'] = 'all'
     dtype: str = 'bfloat16'
 
     @property
@@ -105,23 +117,31 @@ def init(rng: jax.Array, cfg: MistralConfig) -> dict:
                 out['bias'] = normal(bkey, (shape[-1],))
             return out
 
-        layers.append(
-            {
-                'q': proj(ks[0], ks[7], (h, q_out)),
-                'k': proj(ks[1], ks[8], (h, kv_out)),
-                'v': proj(ks[2], ks[9], (h, kv_out)),
-                'o': {'kernel': normal(ks[3], (q_out, h))},
-                'attn_ln': {'scale': np.ones((h,), np.float32)},
-                'gate': {'kernel': normal(ks[4], (h, i))},
-                'up': {'kernel': normal(ks[5], (h, i))},
-                'down': {'kernel': normal(ks[6], (i, h))},
-                'mlp_ln': {'scale': np.ones((h,), np.float32)},
-            }
-        )
+        # Gemma's (1+w) norms are identity at w=0; others at w=1.
+        ln_init = 0.0 if cfg.norm_plus_one else 1.0
+        lp = {
+            'q': proj(ks[0], ks[7], (h, q_out)),
+            'k': proj(ks[1], ks[8], (h, kv_out)),
+            'v': proj(ks[2], ks[9], (h, kv_out)),
+            'o': {'kernel': normal(ks[3], (q_out, h))},
+            'attn_ln': {'scale': np.full((h,), ln_init, np.float32)},
+            'gate': {'kernel': normal(ks[4], (h, i))},
+            'up': {'kernel': normal(ks[5], (h, i))},
+            'down': {'kernel': normal(ks[6], (i, h))},
+            'mlp_ln': {'scale': np.full((h,), ln_init, np.float32)},
+        }
+        if cfg.post_norms:
+            lp['post_attn_ln'] = {'scale': np.full((h,), ln_init, np.float32)}
+            lp['post_mlp_ln'] = {'scale': np.full((h,), ln_init, np.float32)}
+        layers.append(lp)
     params = {
         'embed': normal(keys[1], (cfg.vocab_size, h)),
         'layers': common.stack_layers(layers),
-        'final_ln': {'scale': np.ones((h,), np.float32)},
+        'final_ln': {
+            'scale': np.full(
+                (h,), 0.0 if cfg.norm_plus_one else 1.0, np.float32
+            )
+        },
     }
     if not cfg.tie_word_embeddings:
         params['lm_head'] = normal(keys[2], (h, cfg.vocab_size))
@@ -162,6 +182,7 @@ def init_on_device(rng: jax.Array, cfg: MistralConfig) -> dict:
                 out['bias'] = normal(bkey, (L, shape[-1]))
             return out
 
+        ln_init = 0.0 if cfg.norm_plus_one else 1.0
         params = {
             'embed': normal(keys[0], (cfg.vocab_size, h)),
             'layers': {
@@ -169,14 +190,21 @@ def init_on_device(rng: jax.Array, cfg: MistralConfig) -> dict:
                 'k': proj(keys[2], keys[10], (L, h, kv_out)),
                 'v': proj(keys[3], keys[11], (L, h, kv_out)),
                 'o': {'kernel': normal(keys[4], (L, q_out, h))},
-                'attn_ln': {'scale': jnp.ones((L, h), dtype)},
+                'attn_ln': {'scale': jnp.full((L, h), ln_init, dtype)},
                 'gate': {'kernel': normal(keys[5], (L, h, i))},
                 'up': {'kernel': normal(keys[6], (L, h, i))},
                 'down': {'kernel': normal(keys[7], (L, i, h))},
-                'mlp_ln': {'scale': jnp.ones((L, h), dtype)},
+                'mlp_ln': {'scale': jnp.full((L, h), ln_init, dtype)},
             },
-            'final_ln': {'scale': jnp.ones((h,), dtype)},
+            'final_ln': {'scale': jnp.full((h,), ln_init, dtype)},
         }
+        if cfg.post_norms:
+            params['layers']['post_attn_ln'] = {
+                'scale': jnp.full((L, h), ln_init, dtype)
+            }
+            params['layers']['post_mlp_ln'] = {
+                'scale': jnp.full((L, h), ln_init, dtype)
+            }
         if not cfg.tie_word_embeddings:
             params['lm_head'] = normal(keys[8], (h, cfg.vocab_size))
         return params
@@ -206,8 +234,9 @@ def _mlp_block(normed: jnp.ndarray, lp: dict, cfg) -> jnp.ndarray:
             cfg.experts_per_token,
         )
         return out[:, 0] if normed.ndim == 2 else out
+    act = common.ACTIVATIONS[getattr(cfg, 'activation', 'silu')]
     return common.dense(
-        common.silu(common.dense(normed, lp['gate']['kernel']))
+        act(common.dense(normed, lp['gate']['kernel']))
         * common.dense(normed, lp['up']['kernel']),
         lp['down']['kernel'],
     )
@@ -219,6 +248,30 @@ def _rope_tables(cfg: MistralConfig, max_len: int):
         getattr(cfg, 'rope_scaling', None),
     )
     return jnp.asarray(cos), jnp.asarray(sin)
+
+
+def _norm(x: jnp.ndarray, scale: jnp.ndarray, cfg) -> jnp.ndarray:
+    return common.rms_norm(
+        x, scale, cfg.rms_norm_eps,
+        plus_one=getattr(cfg, 'norm_plus_one', False),
+    )
+
+
+def _embed_tokens(params: dict, cfg, input_ids: jnp.ndarray) -> jnp.ndarray:
+    dtype = jnp.dtype(cfg.dtype)
+    x = jnp.asarray(params['embed'])[input_ids].astype(dtype)
+    if getattr(cfg, 'embedding_multiplier', None) is not None:
+        # Gemma scales embeddings by sqrt(hidden) CAST TO THE COMPUTE
+        # DTYPE (HF casts the normalizer tensor); matching the rounding
+        # keeps bf16 goldens exact.
+        x = x * jnp.asarray(cfg.embedding_multiplier, dtype)
+    return x
+
+
+def _layer_window_flags(cfg) -> jnp.ndarray:
+    """Per-layer bool [L]: does layer i use the sliding window?
+    (gemma2 'alternating': even layers local, odd layers global)."""
+    return jnp.arange(cfg.num_layers) % 2 == 0
 
 
 def _attn_mask(attention_mask: jnp.ndarray, cfg: MistralConfig) -> jnp.ndarray:
@@ -270,10 +323,9 @@ def _forward(
     params, cfg, input_ids, attention_mask, *, collect_kv,
     mesh=None, seq_parallel=None,
 ):
-    dtype = jnp.dtype(cfg.dtype)
     b, s = input_ids.shape
     cos, sin = _rope_tables(cfg, s)
-    x = jnp.asarray(params['embed'])[input_ids].astype(dtype)
+    x = _embed_tokens(params, cfg, input_ids)
     use_sp = (
         seq_parallel is not None
         and mesh is not None
@@ -283,11 +335,32 @@ def _forward(
         raise NotImplementedError(
             'sequence parallelism with sliding-window attention'
         )
-    mask = None if use_sp else _attn_mask(attention_mask, cfg)
+    if use_sp and getattr(cfg, 'attn_logit_softcap', None) is not None:
+        raise NotImplementedError(
+            'sequence parallelism with attention logit softcapping'
+        )
+    alternating = (
+        getattr(cfg, 'sliding_window_pattern', 'all') == 'alternating'
+    )
+    if alternating and not use_sp:
+        # Per-layer mask choice (gemma2): global causal for odd layers,
+        # windowed for even — both built once, selected per scan step.
+        full_mask = _attn_mask(
+            attention_mask, cfg.model_copy(update={'sliding_window': None})
+        )
+        win_mask = _attn_mask(attention_mask, cfg)
+        mask = full_mask
+    else:
+        mask = None if use_sp else _attn_mask(attention_mask, cfg)
     positions = None  # prefill positions are 0..S-1 per row
 
-    def layer(x, lp):
-        normed = common.rms_norm(x, lp['attn_ln']['scale'], cfg.rms_norm_eps)
+    def layer(x, xs):
+        lp, win_flag = xs
+        if alternating and not use_sp:
+            mask_l = jnp.where(win_flag, win_mask, full_mask)
+        else:
+            mask_l = mask
+        normed = _norm(x, lp['attn_ln']['scale'], cfg)
         q = common.split_heads(
             common.dense(normed, lp['q']['kernel'], lp['q'].get('bias')),
             cfg.num_heads,
@@ -321,14 +394,26 @@ def _forward(
         else:
             # GQA handled natively by the fused attention (no KV
             # materialization).
-            attn = common.sdpa(q, k, v, mask=mask)
-        x = x + common.dense(common.merge_heads(attn), lp['o']['kernel'])
-        normed2 = common.rms_norm(x, lp['mlp_ln']['scale'], cfg.rms_norm_eps)
-        x = x + _mlp_block(normed2, lp, cfg)
+            attn = common.sdpa(
+                q, k, v, mask=mask_l,
+                scale=getattr(cfg, 'query_scale', None),
+                logit_softcap=getattr(cfg, 'attn_logit_softcap', None),
+            )
+        attn_out = common.dense(common.merge_heads(attn), lp['o']['kernel'])
+        if getattr(cfg, 'post_norms', False):
+            attn_out = _norm(attn_out, lp['post_attn_ln']['scale'], cfg)
+        x = x + attn_out
+        normed2 = _norm(x, lp['mlp_ln']['scale'], cfg)
+        mlp = _mlp_block(normed2, lp, cfg)
+        if getattr(cfg, 'post_norms', False):
+            mlp = _norm(mlp, lp['post_mlp_ln']['scale'], cfg)
+        x = x + mlp
         return x, (k, v) if collect_kv else None
 
-    x, kv = jax.lax.scan(layer, x, params['layers'])
-    hidden = common.rms_norm(x, params['final_ln']['scale'], cfg.rms_norm_eps)
+    x, kv = jax.lax.scan(
+        layer, x, (params['layers'], _layer_window_flags(cfg))
+    )
+    hidden = _norm(x, params['final_ln']['scale'], cfg)
     if collect_kv:
         return hidden, kv[0], kv[1]
     return hidden, None, None
@@ -367,23 +452,52 @@ def _decode_core(
         write_token_kv,
     )
 
+    alternating = (
+        getattr(cfg, 'sliding_window_pattern', 'all') == 'alternating'
+    )
+    if attn_backend != 'xla' and (
+        alternating
+        or getattr(cfg, 'attn_logit_softcap', None) is not None
+        or getattr(cfg, 'query_scale', None) is not None
+    ):
+        # The Pallas kernel has no softcap / per-layer-window / custom-
+        # scale support; backend resolution (ops.paged_attention.
+        # supports_model) routes these families to XLA — reaching here
+        # means a config forced 'pallas' explicitly, which must fail
+        # loudly, not serve wrong.
+        raise NotImplementedError(
+            'pallas paged attention does not support logit softcapping, '
+            'alternating sliding windows, or query_scale (gemma2); '
+            'use attn_backend=xla'
+        )
+
     if attn_backend == 'xla':
 
-        def attend(q, k_cache_l, v_cache_l):
+        def attend(q, k_cache_l, v_cache_l, window_l):
             return paged_attention_xla(
                 q, k_cache_l, v_cache_l, block_tables, context_lens,
-                sliding_window=cfg.sliding_window,
+                # Traced per-layer window only for the alternating pattern;
+                # other families keep the static value so their decode HLO
+                # is unchanged.
+                sliding_window=window_l if alternating else cfg.sliding_window,
+                scale=getattr(cfg, 'query_scale', None),
+                logit_softcap=getattr(cfg, 'attn_logit_softcap', None),
             )
     else:
 
-        def attend(q, k_cache_l, v_cache_l):
+        def attend(q, k_cache_l, v_cache_l, window_l):
             return paged_attention_pallas(
                 q, k_cache_l, v_cache_l, block_tables, context_lens,
                 sliding_window=cfg.sliding_window,
             )
 
-    dtype = jnp.dtype(cfg.dtype)
-    x = jnp.asarray(params['embed'])[input_ids].astype(dtype)  # [B, H]
+    # int32 [L] per-layer windows (0 = global) riding the layer scan; only
+    # consulted when `alternating`.
+    layer_windows = jnp.where(
+        _layer_window_flags(cfg), cfg.sliding_window or 0, 0
+    ).astype(jnp.int32)
+
+    x = _embed_tokens(params, cfg, input_ids)  # [B, H]
 
     # The FULL caches ride the scan carry and each layer dynamic-update-
     # slices its own [num_blocks, bs, Nkv, Hd] plane in place. Rolled
@@ -398,10 +512,10 @@ def _decode_core(
     # v5e's 16 GB HBM.)
     def layer(carry, xs):
         x, k_cache, v_cache = carry
-        lp, li = xs
+        lp, li, window_l = xs
         k_cache_l = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
         v_cache_l = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
-        normed = common.rms_norm(x, lp['attn_ln']['scale'], cfg.rms_norm_eps)
+        normed = _norm(x, lp['attn_ln']['scale'], cfg)
         q = common.dense(normed, lp['q']['kernel'], lp['q'].get('bias')).reshape(
             -1, cfg.num_heads, cfg.head_size
         )
@@ -417,12 +531,17 @@ def _decode_core(
         k_cache_l, v_cache_l = write_token_kv(
             k_cache_l, v_cache_l, k, v, block_tables, positions
         )
-        attn = attend(q, k_cache_l, v_cache_l)
-        x = x + common.dense(
+        attn = attend(q, k_cache_l, v_cache_l, window_l)
+        attn_out = common.dense(
             attn.reshape(-1, cfg.num_heads * cfg.head_size), lp['o']['kernel']
         )
-        normed2 = common.rms_norm(x, lp['mlp_ln']['scale'], cfg.rms_norm_eps)
+        if getattr(cfg, 'post_norms', False):
+            attn_out = _norm(attn_out, lp['post_attn_ln']['scale'], cfg)
+        x = x + attn_out
+        normed2 = _norm(x, lp['mlp_ln']['scale'], cfg)
         mlp = _mlp_block(normed2, lp, cfg)
+        if getattr(cfg, 'post_norms', False):
+            mlp = _norm(mlp, lp['post_mlp_ln']['scale'], cfg)
         k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k_cache_l, li, 0)
         v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v_cache_l, li, 0)
         return (x + mlp, k_cache, v_cache), None
@@ -430,10 +549,14 @@ def _decode_core(
     (x, k_cache, v_cache), _ = jax.lax.scan(
         layer,
         (x, k_cache, v_cache),
-        (params['layers'], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+        (
+            params['layers'],
+            jnp.arange(cfg.num_layers, dtype=jnp.int32),
+            layer_windows,
+        ),
         unroll=cfg.num_layers if layer_unroll else 1,
     )
-    hidden = common.rms_norm(x, params['final_ln']['scale'], cfg.rms_norm_eps)
+    hidden = _norm(x, params['final_ln']['scale'], cfg)
     return logits(params, cfg, hidden), k_cache, v_cache
 
 
@@ -552,7 +675,10 @@ def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray
         kernel = jnp.asarray(params['embed']).T
     else:
         kernel = jnp.asarray(params['lm_head'])
-    return common.dense(hidden, kernel).astype(jnp.float32)
+    out = common.dense(hidden, kernel).astype(jnp.float32)
+    if getattr(cfg, 'final_logit_softcap', None) is not None:
+        out = common.softcap(out, cfg.final_logit_softcap)
+    return out
 
 
 def param_specs(cfg: MistralConfig, params: dict | None = None) -> dict:
@@ -584,6 +710,9 @@ def param_specs(cfg: MistralConfig, params: dict | None = None) -> dict:
         },
         'final_ln': {'scale': P()},
     }
+    if getattr(cfg, 'post_norms', False):
+        specs['layers']['post_attn_ln'] = {'scale': P(None)}
+        specs['layers']['post_mlp_ln'] = {'scale': P(None)}
     has_lm_head = (
         'lm_head' in params if params is not None else not cfg.tie_word_embeddings
     )

@@ -45,15 +45,41 @@ def supported_head_dim(head_dim: int) -> bool:
     return head_dim in TESTED_HEAD_DIMS
 
 
+def supports_model(model_cfg) -> bool:
+    """May `attn_backend='auto'` select the Pallas kernel for this model?
+
+    Beyond the head-dim contract, the kernel implements neither attention
+    logit softcapping, nor per-layer (alternating) sliding windows, nor a
+    non-default score scale — gemma2 checkpoints route to XLA regardless
+    of head_dim.
+    """
+    return (
+        supported_head_dim(model_cfg.head_size)
+        and getattr(model_cfg, 'attn_logit_softcap', None) is None
+        and getattr(model_cfg, 'query_scale', None) is None
+        and getattr(model_cfg, 'sliding_window_pattern', 'all') == 'all'
+    )
+
+
 def paged_attention_xla(
     q: jnp.ndarray,  # [B, num_heads, head_dim]
     k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     context_lens: jnp.ndarray,  # [B] int32 (valid tokens incl. current)
-    sliding_window: int | None = None,
+    sliding_window: 'int | jnp.ndarray | None' = None,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
 ) -> jnp.ndarray:
-    """Reference implementation: gather blocks then masked attention."""
+    """Reference implementation: gather blocks then masked attention.
+
+    ``sliding_window`` may be a static int, None, or a TRACED int32 scalar
+    (per-layer windows riding a layer scan — gemma2's alternating
+    local/global pattern; 0/negative means no window on that layer).
+    ``scale`` overrides the 1/sqrt(head_dim) score scale
+    (query_pre_attn_scalar); ``logit_softcap`` applies tanh(s/cap)*cap to
+    the scaled scores before masking (both gemma2).
+    """
     b, num_heads, head_dim = q.shape
     _, block_size, num_kv_heads, _ = k_cache.shape
     max_blocks = block_tables.shape[1]
@@ -65,12 +91,23 @@ def paged_attention_xla(
 
     qg = q.reshape(b, num_kv_heads, group, head_dim).astype(jnp.float32)
     scores = jnp.einsum('bkgd,btkd->bkgt', qg, k.astype(jnp.float32))
-    scores = scores / jnp.sqrt(jnp.float32(head_dim))
+    scores = scores * jnp.float32(
+        scale if scale is not None else head_dim ** -0.5
+    )
+    if logit_softcap is not None:
+        from distllm_tpu.models.common import softcap
+
+        scores = softcap(scores, logit_softcap)
     positions = jnp.arange(max_blocks * block_size)[None, :]
     valid = positions < context_lens[:, None]
     if sliding_window is not None:
         # Match prefill's window mask: only the last `sliding_window` keys.
-        valid = valid & (positions > context_lens[:, None] - 1 - sliding_window)
+        # For a traced window, <= 0 disables the clamp on that layer.
+        windowed = positions > context_lens[:, None] - 1 - sliding_window
+        if isinstance(sliding_window, int):
+            valid = valid & windowed
+        else:
+            valid = valid & (windowed | (sliding_window <= 0))
     scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum('bkgt,btkd->bkgd', probs, v.astype(jnp.float32))

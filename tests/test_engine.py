@@ -547,3 +547,55 @@ def test_sampling_windowed_greedy_and_engine_path():
         jnp.zeros(2), top_window=99,
     )
     assert list(np.asarray(toks2)) == [1, 0]
+
+
+def test_engine_greedy_gemma2_matches_dense_forward():
+    """The paged decode path (traced per-layer windows, softcaps, sandwich
+    norms, (1+w) norms, scaled embeddings) serves gemma2 token-exactly vs
+    the dense re-forward — long enough that decode positions pass the
+    sliding window on the local (even) layers."""
+    from distllm_tpu.models import gemma
+
+    cfg = gemma.GemmaConfig(
+        name='gemma2', vocab_size=64, hidden_size=32, num_layers=4,
+        num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=64,
+        max_position_embeddings=64, dtype='float32',
+        activation='gelu_new', embedding_multiplier=32 ** 0.5,
+        norm_plus_one=True, post_norms=True, query_scale=16 ** -0.5,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        sliding_window=6, sliding_window_pattern='alternating',
+        tie_word_embeddings=True, rms_norm_eps=1e-6,
+    )
+    params = gemma.init(jax.random.PRNGKey(1), cfg)
+
+    class IdTokenizer:
+        eos_id = None
+
+        def decode(self, ids):
+            return ' '.join(str(i) for i in ids)
+
+    engine = LLMEngine(
+        cfg, params, IdTokenizer(),
+        EngineConfig(
+            block_size=4, num_blocks=64, max_num_seqs=4, max_model_len=64,
+            prefer_native_allocator=False,
+        ),
+    )
+    prompts = [[5, 9, 12], [7, 3, 22, 31, 40, 2, 17]]
+    n = 10  # prompt+decode crosses the window=6 boundary
+    outs = engine.generate_ids(
+        prompts, SamplingParams(temperature=0.0, max_tokens=n)
+    )
+
+    def dense_greedy(prompt):
+        ids = list(prompt)
+        for _ in range(n):
+            arr = np.asarray([ids], np.int32)
+            hidden = gemma.apply(params, cfg, arr, np.ones_like(arr))
+            lg = gemma.logits(params, cfg, hidden[:, -1])
+            ids.append(int(np.argmax(np.asarray(lg)[0])))
+        return ids[len(prompt):]
+
+    for prompt, out in zip(prompts, outs):
+        ref = dense_greedy(prompt)
+        assert out == ref, f'{out} != {ref}'

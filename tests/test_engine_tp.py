@@ -117,3 +117,32 @@ def test_tp2_with_continuous_batching_churn(model):
     tp = _generate(cfg, params, mesh, prompts, max_tokens=8)
 
     assert single == tp
+
+
+def test_tp2_gemma2_matches_single_device():
+    """Gemma-2's extras (sandwich norms, softcaps, scaled embeddings,
+    alternating windows) must stay token-exact under TP — the post norms
+    are replicated and softcapping is elementwise on already-combined
+    scores, so TP=2 greedy output must equal single-device."""
+    from distllm_tpu.models import gemma
+
+    cfg = gemma.GemmaConfig(
+        name='gemma2', vocab_size=256, hidden_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=128,
+        max_position_embeddings=128, dtype='float32',
+        activation='gelu_new', embedding_multiplier=64 ** 0.5,
+        norm_plus_one=True, post_norms=True, query_scale=16 ** -0.5,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        sliding_window=6, sliding_window_pattern='alternating',
+        tie_word_embeddings=True, rms_norm_eps=1e-6,
+    )
+    params = gemma.init(jax.random.PRNGKey(5), cfg)
+    assert 'post_attn_ln' in params['layers']
+    rng = np.random.default_rng(4)
+    prompts = [
+        list(rng.integers(1, cfg.vocab_size, size=n)) for n in (5, 18, 9)
+    ]
+    single = _generate(cfg, params, None, prompts)
+    mesh = make_mesh(MeshSpec(data=1, model=2), devices=jax.devices()[:2])
+    tp = _generate(cfg, params, mesh, prompts)
+    assert single == tp

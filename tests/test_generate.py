@@ -271,3 +271,52 @@ def test_attn_backend_auto_resolution(monkeypatch):
         pretrained_model_name_or_path='/x', attn_backend='pallas'
     )
     assert resolve(explicit, mc256) == 'pallas'
+
+
+def test_decoder_family_gemma_dispatch():
+    from distllm_tpu.models import decoder_family, gemma
+
+    for model_type in ('gemma', 'gemma2'):
+        cfg_cls, family = decoder_family(model_type)
+        assert cfg_cls is gemma.GemmaConfig and family is gemma
+    cfg = gemma.GemmaConfig.from_hf_config(
+        {'model_type': 'gemma2', 'vocab_size': 64, 'hidden_size': 32,
+         'num_hidden_layers': 2, 'num_attention_heads': 4,
+         'num_key_value_heads': 2, 'head_dim': 16, 'intermediate_size': 64,
+         'hidden_activation': 'gelu_pytorch_tanh',
+         'query_pre_attn_scalar': 16, 'sliding_window': 8,
+         'attn_logit_softcapping': 50.0, 'final_logit_softcapping': 30.0}
+    )
+    assert cfg.post_norms and cfg.sliding_window_pattern == 'alternating'
+    # And the Pallas auto-gate refuses softcap models even at head_dim 128.
+    from types import SimpleNamespace
+
+    from distllm_tpu.ops.paged_attention import supports_model
+
+    assert not supports_model(cfg)
+    assert supports_model(
+        SimpleNamespace(head_size=128, attn_logit_softcap=None,
+                        sliding_window_pattern='all')
+    )
+
+
+def test_generation_config_eos_fallback(tmp_path):
+    import json
+
+    from distllm_tpu.generate.generators.tpu_backend import (
+        _generation_config_eos,
+    )
+
+    assert _generation_config_eos(tmp_path) == ()
+    (tmp_path / 'generation_config.json').write_text(
+        json.dumps({'eos_token_id': 1})
+    )
+    assert _generation_config_eos(tmp_path) == (1,)
+    # gemma-2-it style: EVERY listed id must stop generation (vLLM parity).
+    (tmp_path / 'generation_config.json').write_text(
+        json.dumps({'eos_token_id': [106, 107]})
+    )
+    assert _generation_config_eos(tmp_path) == (106, 107)
+    for bad in ('not json', '[1, 2]', '{"eos_token_id": "<eos>"}'):
+        (tmp_path / 'generation_config.json').write_text(bad)
+        assert _generation_config_eos(tmp_path) == ()

@@ -483,3 +483,74 @@ def test_mixtral_ep_sharding_matches_single_device():
     fn = jax.jit(lambda p, i, m: jmix.apply(p, cfg, i, m))
     out = np.asarray(fn(sharded, ids, mask))
     np.testing.assert_allclose(out, expected, atol=1e-5, rtol=1e-5)
+
+
+def test_gemma_matches_hf(np_rng):
+    """Gemma-1: GeGLU, sqrt(hidden) embedding scale, (1+w) RMSNorm, tied
+    embeddings — all config knobs on the shared family forward."""
+    from transformers import GemmaConfig, GemmaModel
+
+    from distllm_tpu.models import gemma as jgemma
+
+    hf_cfg = GemmaConfig(
+        vocab_size=101, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=64,
+        hidden_act='gelu_pytorch_tanh', rms_norm_eps=1e-6,
+    )
+    model = GemmaModel(hf_cfg).eval()
+    cfg = jgemma.GemmaConfig.from_hf_config(hf_cfg.to_dict())
+    assert cfg.norm_plus_one and cfg.embedding_multiplier is not None
+    cfg.dtype = 'float32'
+    params = jgemma.params_from_hf(_to_numpy_state(model), cfg)
+
+    ids, mask = _rand_batch(np_rng, 2, 12, 101)
+    with torch.no_grad():
+        ref = model(
+            input_ids=torch.tensor(ids.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+        ).last_hidden_state.numpy()
+    ours = np.asarray(jgemma.apply(params, cfg, ids, mask))
+    np.testing.assert_allclose(ours, ref, atol=3e-5, rtol=1e-4)
+
+
+def test_gemma2_matches_hf(np_rng):
+    """Gemma-2 adds sandwich norms, logit softcaps, query_pre_attn scaling
+    and the alternating local/global window pattern; golden against HF
+    incl. a sequence LONGER than the sliding window so the per-layer
+    window masks are load-bearing."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    from distllm_tpu.models import gemma as jgemma
+
+    hf_cfg = Gemma2Config(
+        vocab_size=101, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=96,
+        hidden_activation='gelu_pytorch_tanh', rms_norm_eps=1e-6,
+        query_pre_attn_scalar=16, sliding_window=8,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        attn_implementation='eager',  # softcap path; sdpa impl drops it
+    )
+    model = Gemma2ForCausalLM(hf_cfg).eval()
+    cfg = jgemma.GemmaConfig.from_hf_config(hf_cfg.to_dict())
+    assert cfg.post_norms and cfg.sliding_window_pattern == 'alternating'
+    assert cfg.attn_logit_softcap == 50.0
+    cfg.dtype = 'float32'
+    params = jgemma.params_from_hf(_to_numpy_state(model), cfg)
+
+    # seq 24 > window 8: window masks matter on the even (local) layers.
+    ids, mask = _rand_batch(np_rng, 2, 24, 101)
+    with torch.no_grad():
+        ref = model(
+            input_ids=torch.tensor(ids.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+        ).logits.numpy()
+    hidden = np.asarray(jgemma.apply(params, cfg, ids, mask))
+    ours = np.asarray(jgemma.logits(params, cfg, hidden))
+    np.testing.assert_allclose(ours, ref, atol=5e-5, rtol=1e-4)
+    # The alternating pattern is load-bearing: all-global diverges.
+    cfg_glob = cfg.model_copy(update={'sliding_window': None,
+                                      'sliding_window_pattern': 'all'})
+    glob_hidden = np.asarray(jgemma.apply(params, cfg_glob, ids, mask))
+    assert np.abs(glob_hidden - hidden).max() > 1e-4
