@@ -250,6 +250,15 @@ class LLMEngine:
                 out_dtype=model.dtype,
                 delete_source=self._own_params,
             )
+            if mesh is not None:
+                # GSPMD cannot partition a pallas_call over model-sharded
+                # int8 kernels; pin the process to the XLA scale-after-dot
+                # tier (which partitions like any dot) BEFORE the first
+                # trace. Single-chip serving keeps 'auto' -> Pallas.
+                from distllm_tpu.ops import quantized_matmul as _qmm
+
+                if _qmm.default_backend() == 'auto':
+                    _qmm.set_default_backend('xla')
 
         def prefill_fn(params, ids, mask, last_pos):
             hidden, k, v = mistral.prefill(params, model, ids, mask)

@@ -58,8 +58,23 @@ def dense(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None) -> jnp.ndarra
     layer scan over a quantized tree only ever materializes one layer's
     bf16 weights at a time (dequantizing the whole stack outside the scan
     costs the full float model in HLO temps and OOMs 7B on 16 GiB HBM).
+
+    int8 2-D kernels never dequantize at all: they route through
+    :func:`distllm_tpu.ops.quantized_matmul.int8_dense`, which keeps the
+    weight int8 across HBM (Pallas in-VMEM dequant on TPU, scale-after-dot
+    under XLA). Measured motivation in that module's docstring; override
+    the tier with ``DISTLLM_QMM_BACKEND=auto|pallas|xla|interpret``.
     """
     if hasattr(kernel, 'dequantize'):
+        if getattr(kernel, 'kind', None) == 'int8' and kernel.q.ndim == 2:
+            from distllm_tpu.ops import quantized_matmul as _qmm
+
+            y = _qmm.int8_dense(
+                x, kernel.q, kernel.scale, backend=_qmm.default_backend()
+            )
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
+            return y
         kernel = kernel.dequantize()
     y = jnp.einsum('...i,io->...o', x, kernel.astype(x.dtype))
     if bias is not None:
