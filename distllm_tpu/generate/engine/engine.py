@@ -252,13 +252,17 @@ class LLMEngine:
             )
             if mesh is not None:
                 # GSPMD cannot partition a pallas_call over model-sharded
-                # int8 kernels; pin the process to the XLA scale-after-dot
-                # tier (which partitions like any dot) BEFORE the first
-                # trace. Single-chip serving keeps 'auto' -> Pallas.
+                # int8 kernels; the XLA scale-after-dot tier partitions
+                # like any dot. 'auto' already means 'xla', so only an
+                # explicit process-wide 'pallas' pin needs rejecting.
                 from distllm_tpu.ops import quantized_matmul as _qmm
 
-                if _qmm.default_backend() == 'auto':
-                    _qmm.set_default_backend('xla')
+                if _qmm.default_backend() in ('pallas', 'interpret'):
+                    raise ValueError(
+                        'quantized-matmul backend '
+                        f'{_qmm.default_backend()!r} cannot serve under a '
+                        "tensor-parallel mesh; use 'auto'/'xla'"
+                    )
 
         def prefill_fn(params, ids, mask, last_pos):
             hidden, k, v = mistral.prefill(params, model, ids, mask)

@@ -61,9 +61,10 @@ def dense(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None) -> jnp.ndarra
 
     int8 2-D kernels never dequantize at all: they route through
     :func:`distllm_tpu.ops.quantized_matmul.int8_dense`, which keeps the
-    weight int8 across HBM (Pallas in-VMEM dequant on TPU, scale-after-dot
-    under XLA). Measured motivation in that module's docstring; override
-    the tier with ``DISTLLM_QMM_BACKEND=auto|pallas|xla|interpret``.
+    weight int8 across HBM (scale applied to the dot's OUTPUT, convert
+    fused into the weight stream). Measured motivation and tier choice in
+    that module's docstring; override with
+    ``DISTLLM_QMM_BACKEND=auto|pallas|xla|interpret`` (read at import).
     """
     if hasattr(kernel, 'dequantize'):
         if getattr(kernel, 'kind', None) == 'int8' and kernel.q.ndim == 2:

@@ -10,17 +10,22 @@ floor: the "quantized" model was streaming ~3x the bytes of the bf16 one.
 
 The fix has two tiers, chosen by :func:`int8_dense`:
 
-- **Pallas kernel** (:func:`int8_matmul_pallas`) for the decode regime
-  (few rows, huge weight): streams int8 tiles HBM->VMEM, converts to the
-  activation dtype in VMEM (registers, effectively), feeds the MXU, and
-  applies the per-output-channel scale once to the fp32 accumulator at the
-  last K step. HBM traffic for the weight is exactly its int8 size.
-- **XLA scale-after-dot** for everything else (prefill, CPU tests, tile
-  mismatches): ``(x @ q.astype(dtype)) * scale`` — algebraically identical
-  to ``x @ (q * scale)`` because the int8 scale is per-OUTPUT-channel
-  (`quantization.quantize_int8` reduces only the input dim), but the
-  full-size elementwise multiply on the weight is gone; only the convert
-  remains for XLA to fuse or materialize.
+- **XLA scale-after-dot** — the tier ``'auto'`` always picks, because it
+  WINS on hardware: ``(x @ q.astype(dtype)) * scale`` is algebraically
+  identical to ``x @ (q * scale)`` (the int8 scale is per-OUTPUT-channel;
+  `quantization.quantize_int8` reduces only the input dim), the full-size
+  elementwise multiply on the weight is gone, and XLA fuses the int8→bf16
+  convert into the dot's weight stream. Measured at the 7B unrolled
+  16-step decode window (`chipback_r05/probe_decode_int8.log`): 315 ms at
+  batch 32 = 1623 tok/s, vs 465 ms bf16 and 1242 ms for the old
+  dequant-before-dot serving path.
+- **Pallas kernel** (:func:`int8_matmul_pallas`): streams int8 tiles
+  HBM->VMEM, converts in VMEM, applies the per-output-channel scale once
+  to the fp32 accumulator at the last K step. Kept for explicit selection
+  and as the substrate for future fused variants, but it LOSES to the XLA
+  tier everywhere measured (same log: 720 ms/window at batch 32, 1676 ms
+  at batch 128; 5.4x slower than bf16 on the 4096x32000 lm_head, where
+  its 256-wide N tiles yield 2000 grid steps) — so 'auto' never picks it.
 
 Reference parity note: the reference gets weight-only-quantized serving
 from bitsandbytes via HF (`distllm/generate/generators/huggingface_backend.py:66-77`)
@@ -177,14 +182,9 @@ def int8_dense(
 ) -> jnp.ndarray:
     """``x @ dequant(q, scale)`` for a 2-D int8 QTensor, any leading dims.
 
-    ``backend``: 'auto' (pallas on TPU when the shape fits, else XLA),
-    'pallas', 'xla', 'interpret' (pallas interpret mode — CPU tests).
-
-    'auto' assumes ``q`` is unsharded (single-device or fully replicated):
-    GSPMD cannot partition a ``pallas_call`` over a tensor-parallel mesh,
-    so the engine pins the process tier to 'xla' (:func:`set_default_backend`)
-    before compiling a TP+int8 step — the XLA tier's plain dot partitions
-    like any other matmul.
+    ``backend``: 'auto' == 'xla' (scale-after-dot — measured fastest tier,
+    module docstring), 'pallas' / 'interpret' force the Pallas kernel
+    (compiled / interpret mode).
     """
     if backend not in BACKENDS:
         raise ValueError(
@@ -196,13 +196,7 @@ def int8_dense(
     for d in lead:
         m *= d
     x2 = x.reshape(m, k)
-    use_pallas = False
-    if backend in ('pallas', 'interpret'):
-        use_pallas = True
-    elif backend == 'auto':
-        use_pallas = (
-            pallas_supported(m, k, n) and jax.default_backend() == 'tpu'
-        )
+    use_pallas = backend in ('pallas', 'interpret')
     if use_pallas:
         out = int8_matmul_pallas(
             x2, q, scale, interpret=(backend == 'interpret')

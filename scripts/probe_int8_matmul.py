@@ -36,14 +36,18 @@ from distllm_tpu.ops import quantized_matmul as qmm
 from distllm_tpu.ops.quantization import quantize_int8
 
 
-def _time(fn, *args, reps=8):
+def _time(fn, *args, reps=64):
+    """ms/call with the ~66 ms tunnel RTT amortized: queue `reps` async
+    dispatches, host-sync ONCE on the last output. Per-call sync would
+    measure the tunnel, not the kernel (first version of this probe did —
+    every case reported exactly the RTT)."""
     out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(out[0, :1])  # tunnel-safe host sync
+    np.asarray(out[0, :1])  # compile + settle
     t0 = time.perf_counter()
-    outs = [fn(*args) for _ in range(reps)]
-    for o in outs:
-        np.asarray(o[0, :1])
+    for _ in range(reps - 1):
+        out = fn(*args)
+    out = fn(*args)
+    np.asarray(out[0, :1])
     return (time.perf_counter() - t0) / reps
 
 
