@@ -5,7 +5,8 @@ reliable sync is fetching a (tiny) result to host, so every timed op reduces
 to a scalar and the timer ends on ``float(...)``.
 """
 
-from __future__ import sys as _sys, pathlib as _pl
+import sys as _sys
+import pathlib as _pl
 _sys.path.insert(0, str(_pl.Path(__file__).resolve().parent.parent))
 
 from distllm_tpu.utils import apply_platform_env
