@@ -139,6 +139,15 @@ class EngineConfig(BaseConfig):
     # Decode windows in flight during generate_ids (2 hides the
     # host<->device round trip behind the next window's compute).
     pipeline_depth: int = 2
+    # Keep prefill's first-token fetch on device and process it with the
+    # in-flight window records (sampled tokens scatter into the carried
+    # last-ids vector). Token-exact either way. Default OFF: on the axon
+    # tunnel the extra tiny dispatches it adds (scatter/merge/slices) cost
+    # more than the 18 blocking sample fetches they remove — measured
+    # 822 -> 636 tok/s on the r5 serving workload (probe_gen,
+    # chipback_r05). Revisit on directly-attached hardware, where
+    # per-dispatch latency is microseconds, not milliseconds.
+    defer_prefill: bool = False
     seed: int = 0
 
 
@@ -336,6 +345,12 @@ class LLMEngine:
         # Tokens dispatched on device but not yet fetched, per request —
         # the pipelined path's lag bookkeeping.
         self._unacked: dict[int, int] = {}
+        # Device-side last-token vector carried across the pipelined loop;
+        # deferred prefill scatters freshly sampled first tokens into it.
+        self._carried = None
+        self._scatter_tokens = jax.jit(
+            lambda carried, slot_idx, toks: carried.at[slot_idx].set(toks)
+        )
 
     def _put(self, x):
         """Host value → device array, replicated over the mesh under TP."""
@@ -512,7 +527,7 @@ class LLMEngine:
                     self._put(block_rows),
                     self._put(lengths),
                 )
-                self._sample_batch(logits, [None] * b)
+                np.asarray(self._sample_device(logits, [None] * b))
                 if b >= cap:
                     break
                 b *= 2
@@ -571,16 +586,18 @@ class LLMEngine:
         return self.sched.has_unfinished
 
     # ------------------------------------------------------------ scheduling
-    def _admit(self) -> list[tuple[int, int]]:
+    def _admit(self, defer_to=None) -> list[tuple[int, int]]:
         """Admit waiting requests while the scheduler allows.
 
-        Returns the first tokens emitted by prefill as (request_id, token).
-        Admissible requests are batch-planned: grouped by prompt-length
-        bucket and prefilled together in one padded dispatch (under many
-        short requests — the MCQA pattern — per-sequence prefill serializes
-        admission behind dispatch latency). A prefill may immediately
-        finish its request (stop token / max_tokens=1), freeing slots, so
-        the admit→prefill cycle repeats until the scheduler yields nothing.
+        Returns the first tokens emitted by prefill as (request_id, token)
+        (empty in deferred mode — they surface when the caller processes
+        the in-flight records in ``defer_to``). Admissible requests are
+        batch-planned: grouped by prompt-length bucket and prefilled
+        together in one padded dispatch (under many short requests — the
+        MCQA pattern — per-sequence prefill serializes admission behind
+        dispatch latency). A synchronous prefill may immediately finish
+        its request (stop token / max_tokens=1), freeing slots, so the
+        admit→prefill cycle repeats until the scheduler yields nothing.
         """
         emitted: list[tuple[int, int]] = []
         while True:
@@ -603,7 +620,9 @@ class LLMEngine:
                 for i in range(0, len(requests), cap):
                     self._stats['prefill_dispatches'] += 1
                     emitted.extend(
-                        self._run_prefill_batch(requests[i : i + cap], bucket)
+                        self._run_prefill_batch(
+                            requests[i : i + cap], bucket, defer_to
+                        )
                     )
 
     def _prefill_batch_cap(self, bucket: int) -> int:
@@ -627,9 +646,13 @@ class LLMEngine:
 
     # -------------------------------------------------------------- prefill
     def _run_prefill_batch(
-        self, requests: list[Request], bucket: int
+        self, requests: list[Request], bucket: int, defer_to=None
     ) -> list[tuple[int, int]]:
         """Prefill same-bucket requests in one padded dispatch.
+
+        ``defer_to`` (a deque of in-flight window records) switches to the
+        pipelined emission path: first tokens stay on device and their
+        host fetch is processed later with the decode windows.
 
         The batch dim pads up the pow2 ladder (capped at
         ``max_prefill_batch``) so the jit cache holds at most
@@ -676,13 +699,42 @@ class LLMEngine:
         slots: list[Request | None] = list(requests) + [None] * (
             b - len(requests)
         )
-        tokens = self._sample_batch(last_logits, slots)
-        emitted = []
+        if defer_to is None:
+            tokens = np.asarray(self._sample_device(last_logits, slots))
+            emitted = []
+            for i, request in enumerate(requests):
+                token = int(tokens[i])
+                self._emit_token(request, token)
+                emitted.append((request.request_id, token))
+            return emitted
+
+        # Pipelined path: the sampled first tokens STAY on device. They are
+        # scattered into the carried last-ids vector (so the next decode
+        # window reads them without a host round trip) and the host fetch
+        # rides the in-flight deque as a 1-step window record — the same
+        # unacked/one-window-late bookkeeping decode EOS already uses.
+        # probe_gen (chipback_r05) showed decode windows already run at
+        # device speed; the serving-loop gap was 18 blocking prefill
+        # fetches serializing against the decode pipeline.
+        tok_dev = self._sample_device(last_logits, slots)
+        slot_of = {rid: slot for slot, rid in self.sched.running()}
+        slot_idx = np.asarray(
+            [slot_of[r.request_id] for r in requests], np.int32
+        )
+        if self._carried is None:
+            self._carried = self._put(
+                np.zeros((self.config.max_num_seqs,), np.int32)
+            )
+        self._carried = self._scatter_tokens(
+            self._carried, self._put(slot_idx), tok_dev[: len(requests)]
+        )
+        plan = []
         for i, request in enumerate(requests):
-            token = int(tokens[i])
-            self._emit_token(request, token)
-            emitted.append((request.request_id, token))
-        return emitted
+            rid = request.request_id
+            self._unacked[rid] = self._unacked.get(rid, 0) + 1
+            plan.append((i, rid, 1))
+        defer_to.append({'tokens': tok_dev[None, :], 'plan': plan})
+        return []
 
     def _block_row(self, rid: int) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -893,14 +945,21 @@ class LLMEngine:
 
         depth = max(1, self.config.pipeline_depth)
         inflight: deque[dict] = deque()
-        carried = None
+        self._carried = None
 
         def process_one() -> None:
             self._process_window(inflight.popleft())
 
         try:
             while self.has_unfinished or inflight:
-                self._admit()
+                # Deferred prefill (opt-in): first tokens stay on device
+                # (scattered into self._carried) and their fetch records
+                # join the in-flight deque instead of blocking the decode
+                # pipeline. See EngineConfig.defer_prefill for why the
+                # default is the synchronous path.
+                self._admit(
+                    defer_to=inflight if self.config.defer_prefill else None
+                )
                 if self.sched.num_running == 0:
                     if inflight:
                         process_one()
@@ -911,12 +970,12 @@ class LLMEngine:
                     > self.sched.num_free_blocks
                 ):
                     process_one()
-                window = self._dispatch_window(carried)
+                window = self._dispatch_window(self._carried)
                 if window is _DRAIN:
                     if inflight:
                         process_one()
                     continue
-                carried = window['last_ids']
+                self._carried = window['last_ids']
                 inflight.append(window)
                 if len(inflight) >= depth:
                     process_one()
@@ -932,7 +991,8 @@ class LLMEngine:
                     self._unacked.clear()
             raise
 
-    def _sample_batch(self, logits: jnp.ndarray, slots) -> np.ndarray:
+    def _sample_device(self, logits: jnp.ndarray, slots) -> jnp.ndarray:
+        """Sample one token per row on DEVICE (no host sync)."""
         b = logits.shape[0]
         temperature = np.zeros((b,), np.float32)
         top_p = np.ones((b,), np.float32)
@@ -945,7 +1005,7 @@ class LLMEngine:
             min_p[i] = request.params.min_p
         self._key, key = jax.random.split(self._key)
         t_dev, tp_dev, mp_dev = self._put_many(temperature, top_p, min_p)
-        return np.asarray(self._sample(logits, key, t_dev, tp_dev, mp_dev))
+        return self._sample(logits, key, t_dev, tp_dev, mp_dev)
 
     def _emit_token(self, request: Request, token: int) -> None:
         # Note: the emitted token is NOT yet written to the KV cache; it is

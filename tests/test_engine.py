@@ -599,3 +599,40 @@ def test_engine_greedy_gemma2_matches_dense_forward():
     for prompt, out in zip(prompts, outs):
         ref = dense_greedy(prompt)
         assert out == ref, f'{out} != {ref}'
+
+
+def test_engine_deferred_prefill_matches_dense_forward():
+    # Opt-in pipelined prefill emission (EngineConfig.defer_prefill):
+    # first tokens stay on device, scatter into the carried last-ids
+    # vector, and are fetched one window late. Must stay token-exact vs
+    # the dense reference, including continuous-batching slot reuse
+    # (more prompts than slots) and a mid-stream finisher.
+    cfg = mistral.MistralConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64, dtype='float32',
+    )
+    params = mistral.init(jax.random.PRNGKey(0), cfg)
+
+    class IdTokenizer:
+        eos_id = None
+
+    engine = LLMEngine(
+        cfg, params, IdTokenizer(),
+        EngineConfig(
+            block_size=4, num_blocks=64, max_num_seqs=2, max_model_len=64,
+            decode_steps=4, pipeline_depth=2, defer_prefill=True,
+            prefer_native_allocator=False,
+        ),
+    )
+    prompts = [[5, 9, 12], [7, 3, 22, 31, 40, 2, 17], [1, 2, 3, 4, 5],
+               [44, 13], [9], [30, 31, 32, 33]]
+    lens = [6, 9, 1, 8, 5, 7]  # mixed budgets incl. max_tokens=1
+    rids = [
+        engine.add_request(p, SamplingParams(temperature=0.0, max_tokens=n))
+        for p, n in zip(prompts, lens)
+    ]
+    engine._run_to_completion()
+    for p, n, rid in zip(prompts, lens, rids):
+        got = engine._finished.pop(rid).output_ids
+        ref = _dense_greedy_reference(cfg, params, p, n)
+        assert got == ref, f'{got} != {ref}'
