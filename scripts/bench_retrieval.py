@@ -120,10 +120,20 @@ def bench_exact(n_queries: int, sizes: list[int], dim: int, top_k: int,
             _sync((s, i))
             times.append(time.perf_counter() - t0)
         best = min(times)
+        # Through the serving tunnel a single call is dominated by the
+        # ~64 ms host<->device round trip; chain 8 async dispatches with
+        # ONE final sync so the RTT amortizes and the per-call number
+        # approaches the device time (same method as probe_decode).
+        reps = 8
+        t0 = time.perf_counter()
+        outs = [topk_inner_product(q, corpus, top_k) for _ in range(reps)]
+        _sync(outs[-1])
+        chained = (time.perf_counter() - t0) / reps
         _emit(
             tier='exact_fp32', rows=n, dim=dim, batch=n_queries,
             top_k=top_k, latency_ms=round(best * 1e3, 1),
-            queries_per_s=round(n_queries / best, 1),
+            latency_chained_ms=round(chained * 1e3, 1),
+            queries_per_s=round(n_queries / chained, 1),
             corpus_gib=round(corpus_bytes / 2**30, 2),
             platform=jax.default_backend(),
         )
