@@ -97,11 +97,12 @@ def quantize_int8_rows(
 
 def int8_topk(
     queries: jnp.ndarray,  # [B, H] fp32
-    codes: jnp.ndarray,  # [N, H] int8 (possibly sharded over mesh 'data')
-    scales: jnp.ndarray,  # [N] fp32 (sharded alongside codes)
+    codes: jnp.ndarray,  # [N, H] int8, or grouped [G, C, H] (group_rows)
+    scales: jnp.ndarray,  # [N] fp32 ([G, C] when grouped)
     k: int,
     mesh: Mesh | None = None,
     chunk_size: int = 1 << 19,
+    n_valid: int | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k inner product against an int8-quantized corpus.
 
@@ -112,7 +113,29 @@ def int8_topk(
     ``O(B * chunk_size)`` rather than ``[B, N]`` — this tier exists for
     corpora past the fp32 HBM limit, where a full score matrix at batch
     128 would itself OOM. Returns (approx scores [B, k], indices [B, k]).
+
+    Pass ``codes`` pre-grouped as ``[G, C, H]`` (:func:`group_rows`, with
+    ``scales [G, C]`` and ``n_valid`` = real row count) for the fast
+    single-dispatch ``lax.scan`` path — what ``TpuIndexV2`` serves with.
     """
+    if codes.ndim == 3:
+        if n_valid is None:
+            # group_rows zero-pads the last slab; without the real row
+            # count those all-zero rows would rank as valid neighbors and
+            # leak out-of-range indices to the caller.
+            raise ValueError('grouped codes [G, C, H] require n_valid')
+        n = n_valid
+        k = min(k, n)
+        qmax = jnp.abs(queries).max(axis=1)
+        qscale = jnp.where(qmax == 0, 1.0, qmax / 127.0)
+        qi = jnp.clip(
+            jnp.round(queries / qscale[:, None]), -127, 127
+        ).astype(jnp.int8)
+        return _grouped_scan_topk(
+            (qi, qscale), codes, (scales,),
+            scorer='int8', k=k,
+            n_valid=n, approx=n >= APPROX_TOPK_MIN_ROWS,
+        )
     n = codes.shape[0]
     k = min(k, n)
     qmax = jnp.abs(queries).max(axis=1)
@@ -121,21 +144,12 @@ def int8_topk(
         jnp.round(queries / qscale[:, None]), -127, 127
     ).astype(jnp.int8)
 
-    def score(q_codes, q_scale, codes_part, scales_part):
-        raw = jax.lax.dot_general(
-            q_codes, codes_part, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )
-        return (
-            raw.astype(jnp.float32) * q_scale[:, None] * scales_part[None, :]
-        )
-
     if mesh is not None and mesh.shape.get('data', 1) > 1:
         # Per-shard rows are already N/shards; each chip scores its slab
         # in one matmul (shard the corpus further if [B, N/shards] scores
         # ever dominate a chip's HBM).
         return _sharded_topk(
-            score, n, (qi, qscale, codes, scales),
+            _score_int8, n, (qi, qscale, codes, scales),
             (P(), P(), P('data', None), P('data')), k, mesh,
         )
 
@@ -147,7 +161,9 @@ def int8_topk(
     @functools.partial(jax.jit, static_argnums=(4,))
     def chunk_topk(q_codes, q_scale, codes_part, scales_part, chunk_k):
         return _chunk_candidates(
-            score(q_codes, q_scale, codes_part, scales_part), chunk_k, approx
+            _score_int8(q_codes, q_scale, codes_part, scales_part),
+            chunk_k,
+            approx,
         )
 
     best_scores = None
@@ -188,6 +204,104 @@ def pack_sign_bits(embeddings: np.ndarray) -> np.ndarray:
 # by top1/rescore behavior, not the last near-tie in the candidate set.
 APPROX_TOPK_MIN_ROWS = 1 << 20
 
+# Grouped-scan slab sizes (rows per lax.scan step) for the quantized
+# tiers — ONE home so the index (rag/search.py) and the retrieval bench
+# measure the same serving layout.
+SCAN_CHUNK_BITS = 1 << 18
+SCAN_CHUNK_INT8 = 1 << 19
+
+
+def group_rows(arr: np.ndarray, chunk: int) -> np.ndarray:
+    """Host-side: pad ``[N, ...]`` to a chunk multiple and reshape to
+    ``[G, chunk, ...]`` — the layout the grouped-scan tops consume.
+
+    Do this ONCE at index build: the grouped tensors ride a single-
+    dispatch ``lax.scan`` whose chunk slabs are contiguous scan slices.
+    Measured on the chip at 10M x 768 int8: 32 ms/scan grouped vs
+    seconds for the python slice-per-chunk loop over a monolithic
+    device array (chipback_r05/probe_retrieval_scan.log and the
+    prof_slice experiments behind it).
+    """
+    n = arr.shape[0]
+    pad = (-n) % chunk
+    if pad:
+        arr = np.concatenate(
+            [arr, np.zeros((pad, *arr.shape[1:]), arr.dtype)]
+        )
+    return arr.reshape(arr.shape[0] // chunk, chunk, *arr.shape[1:])
+
+
+def _score_int8(qi, qscale, codes_part, scales_part):
+    """int8 x int8 → int32 MXU scores with the true scales reapplied —
+    the ONE home for the int8 scoring formula (flat loop, grouped scan,
+    and the sharded path all call this)."""
+    raw = jax.lax.dot_general(
+        qi, codes_part, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return raw.astype(jnp.float32) * qscale[:, None] * scales_part[None, :]
+
+
+def _score_hamming(qu, q_pop, chunk_bits):
+    """Negated Hamming distances via the MXU identity
+    ``hamming(a,b) = |a| + |b| - 2 a·b`` over unpacked 0/1 int8 vectors
+    (higher = closer, so top-k machinery applies unchanged)."""
+    cu = _unpack_bits(chunk_bits)
+    dots = jax.lax.dot_general(
+        qu, cu, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    c_pop = jnp.sum(cu.astype(jnp.int32), axis=1)
+    distances = q_pop[:, None] + c_pop[None, :] - 2 * dots
+    return -distances.astype(jnp.float32)
+
+
+def _score_grouped_chunk(scorer: str, queries, chunk, extras):
+    """Per-chunk fp32 scores [B, C] for the grouped-scan tops."""
+    if scorer == 'int8':
+        qi, qscale = queries
+        (scales_c,) = extras
+        return _score_int8(qi, qscale, chunk, scales_c)
+    if scorer == 'hamming':
+        qu, q_pop = queries
+        return _score_hamming(qu, q_pop, chunk)
+    raise ValueError(scorer)
+
+
+@functools.partial(
+    jax.jit, static_argnames=('scorer', 'k', 'n_valid', 'approx')
+)
+def _grouped_scan_topk(
+    queries, corpus3, extras, *, scorer, k, n_valid, approx
+):
+    """Single-dispatch top-k over a grouped corpus ``[G, C, ...]``.
+
+    Padded rows (global index >= n_valid) mask to -inf before candidate
+    selection; per-chunk candidates merge once at the end (G*chunk_k is
+    tiny). One executable per (scorer, shapes) — the scan runs all G
+    chunks inside a single dispatch, which is what makes the 10M scan
+    ~32 ms instead of seconds of per-chunk dispatch/slice overhead.
+    """
+    c = corpus3.shape[1]
+    chunk_k = min(k, c)
+
+    def body(g, xs):
+        scores = _score_grouped_chunk(scorer, queries, xs[0], xs[1:])
+        base = g * c
+        col = base + jnp.arange(c)[None, :]
+        scores = jnp.where(col < n_valid, scores, -jnp.inf)
+        s, i = _chunk_candidates(scores, chunk_k, approx)
+        return g + 1, (s, i + base)
+
+    _, (ss, ii) = jax.lax.scan(body, 0, (corpus3, *extras))
+    b = ss.shape[1]
+    flat_s = jnp.transpose(ss, (1, 0, 2)).reshape(b, -1)
+    flat_i = jnp.transpose(ii, (1, 0, 2)).reshape(b, -1)
+    # Final exact merge returns the CALLER'S k (bounded by what exists),
+    # not the per-chunk k — k > chunk size must not truncate silently.
+    top_s, pos = jax.lax.top_k(flat_s, min(k, flat_s.shape[1]))
+    return top_s, jnp.take_along_axis(flat_i, pos, axis=1)
+
 
 def _chunk_candidates(scores_f32: jnp.ndarray, k: int, approx: bool):
     if approx:
@@ -205,9 +319,10 @@ def _unpack_bits(packed: jnp.ndarray) -> jnp.ndarray:
 
 def hamming_topk(
     query_bits: jnp.ndarray,  # [B, H/8] uint8
-    corpus_bits: jnp.ndarray,  # [N, H/8] uint8
+    corpus_bits: jnp.ndarray,  # [N, H/8] uint8, or grouped [G, C, H/8]
     k: int,
     chunk_size: int = 1 << 18,
+    n_valid: int | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k by smallest Hamming distance. Returns (distances, indices).
 
@@ -216,12 +331,28 @@ def hamming_topk(
     so each chunk unpacks to int8 in VMEM-sized slabs and scores as an
     int8 x int8 → int32 dot. (The first implementation XOR+popcounted a
     materialized [B, chunk, H/8] tensor and exact-sorted every chunk:
-    12.5 s per 10M-row scan on the chip; this formulation is ~50 ms.)
-    Distances are exact ints; candidate selection per chunk is exact
-    below ``APPROX_TOPK_MIN_ROWS`` rows and TPU ``approx_max_k`` above.
-    The corpus axis is processed in chunks with a running top-k so peak
-    memory is ``O(B * chunk_size)``.
+    12.5 s per 10M-row scan on the chip.) Distances are exact ints;
+    candidate selection per chunk is exact below ``APPROX_TOPK_MIN_ROWS``
+    rows and TPU ``approx_max_k`` above. The corpus axis is processed in
+    chunks with a running top-k so peak memory is ``O(B * chunk_size)``.
+
+    Pass ``corpus_bits`` pre-grouped as ``[G, C, H/8]``
+    (:func:`group_rows`, with ``n_valid`` = real row count) for the
+    single-dispatch ``lax.scan`` path serving uses.
     """
+    if corpus_bits.ndim == 3:
+        if n_valid is None:
+            raise ValueError('grouped corpus [G, C, H/8] requires n_valid')
+        n = n_valid
+        k = min(k, n)
+        qu3 = _unpack_bits(query_bits)
+        q_pop3 = jnp.sum(qu3.astype(jnp.int32), axis=1)
+        neg, idx = _grouped_scan_topk(
+            (qu3, q_pop3), corpus_bits, (),
+            scorer='hamming', k=k,
+            n_valid=n, approx=n >= APPROX_TOPK_MIN_ROWS,
+        )
+        return (-neg).astype(jnp.int32), idx
     n = corpus_bits.shape[0]
     k = min(k, n)
     approx = n >= APPROX_TOPK_MIN_ROWS
@@ -230,17 +361,11 @@ def hamming_topk(
 
     @functools.partial(jax.jit, static_argnums=(3,))
     def chunk_distances(q_unpacked, q_popcount, corpus_chunk, chunk_k):
-        cu = _unpack_bits(corpus_chunk)  # [C, H] int8
-        dots = jax.lax.dot_general(
-            q_unpacked, cu, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )  # [B, C]
-        c_pop = jnp.sum(cu.astype(jnp.int32), axis=1)  # [C]
-        distances = q_popcount[:, None] + c_pop[None, :] - 2 * dots
-        neg, idx = _chunk_candidates(
-            -distances.astype(jnp.float32), chunk_k, approx
+        return _chunk_candidates(
+            _score_hamming(q_unpacked, q_popcount, corpus_chunk),
+            chunk_k,
+            approx,
         )
-        return neg, idx
 
     best_neg = None
     best_idx = None

@@ -33,6 +33,9 @@ from pydantic import Field
 from distllm_tpu.embed.encoders.base import Encoder
 from distllm_tpu.embed.poolers.base import Pooler
 from distllm_tpu.ops.topk import (
+    SCAN_CHUNK_BITS,
+    SCAN_CHUNK_INT8,
+    group_rows,
     hamming_topk,
     int8_topk,
     pack_sign_bits,
@@ -190,12 +193,19 @@ class TpuIndexV2:
         self._num_real = len(self.dataset)
 
         if self.config.precision == 'ubinary':
-            # Packed bits are corpus/32 bytes — assemble on host, then one
-            # device_put. NO second fp32 host copy: rescore candidates are
-            # gathered per query batch from the arrow-mmap'd dataset.
-            self._packed = jnp.asarray(
-                np.concatenate([np.asarray(c) for c in self._iter_stored_chunks()])
-            )
+            # Packed bits are corpus/32 bytes — assemble on host, GROUP
+            # into [G, chunk, H/8] (ops/topk.group_rows), then one
+            # device_put: the grouped layout rides hamming_topk's single-
+            # dispatch lax.scan (~32 ms at 10M rows vs seconds for a
+            # sliced-chunk loop — chipback_r05). NO second fp32 host
+            # copy: rescore candidates are gathered per query batch from
+            # the arrow-mmap'd dataset.
+            self._packed = jnp.asarray(group_rows(
+                np.concatenate(
+                    [np.asarray(c) for c in self._iter_stored_chunks()]
+                ),
+                SCAN_CHUNK_BITS,
+            ))
             self._corpus = None
             self._int8 = None
             return
@@ -204,14 +214,18 @@ class TpuIndexV2:
             # corpus/4 bytes on device (codes) + tiny scales: the middle
             # tier — MXU int8 scoring with fp32 rescore (same rescore path
             # as ubinary). Beyond-reference extension: the reference
-            # validates only float32/ubinary (search.py:172-176).
+            # validates only float32/ubinary (search.py:172-176). Single-
+            # device codes are grouped for the scan path like ubinary.
             parts = list(self._iter_stored_chunks())
             codes = np.concatenate([np.asarray(p['codes']) for p in parts])
             scales = np.concatenate([np.asarray(p['scales']) for p in parts])
             if self.mesh is not None and self.mesh.shape.get('data', 1) > 1:
                 self._int8 = self._put_row_sharded((codes, 0), (scales, 1))
             else:
-                self._int8 = (jnp.asarray(codes), jnp.asarray(scales))
+                self._int8 = (
+                    jnp.asarray(group_rows(codes, SCAN_CHUNK_INT8)),
+                    jnp.asarray(group_rows(scales, SCAN_CHUNK_INT8)),
+                )
             self._packed = None
             self._corpus = None
             return
@@ -301,7 +315,9 @@ class TpuIndexV2:
         oversample = min(
             top_k * self.config.rescore_multiplier, len(self.dataset)
         )
-        _, cand = hamming_topk(query_bits, self._packed, oversample)
+        _, cand = hamming_topk(
+            query_bits, self._packed, oversample, n_valid=self._num_real
+        )
         return self._rescore(queries, np.asarray(cand), top_k)
 
     def _search_int8(self, queries: np.ndarray, top_k: int):
@@ -311,7 +327,7 @@ class TpuIndexV2:
         codes, scales = self._int8
         _, cand = int8_topk(
             jnp.asarray(queries.astype(np.float32)), codes, scales,
-            oversample, self.mesh,
+            oversample, self.mesh, n_valid=self._num_real,
         )
         return self._rescore(queries, np.asarray(cand), top_k)
 

@@ -38,6 +38,9 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from distllm_tpu.ops.topk import (  # noqa: E402
+    SCAN_CHUNK_BITS,
+    SCAN_CHUNK_INT8,
+    group_rows,
     hamming_topk,
     int8_topk,
     pack_sign_bits,
@@ -245,11 +248,15 @@ def bench_ubinary(rows: int, dim: int, n_queries: int, top_k: int,
         )
 
     try:
-        corpus_bits = jax.device_put(packed)
+        # Grouped [G, C, ...] layout (ops/topk.group_rows): the serving
+        # layout — hamming/int8 scans run as ONE lax.scan dispatch.
+        corpus_bits = jax.device_put(group_rows(packed, SCAN_CHUNK_BITS))
         query_bits = jnp.asarray(pack_sign_bits(queries))
         measure(
             'ubinary_rescore',
-            lambda: hamming_topk(query_bits, corpus_bits, oversample)[1],
+            lambda: hamming_topk(
+                query_bits, corpus_bits, oversample, n_valid=rows
+            )[1],
             {'packed_gib': round(packed.nbytes / 2**30, 3)},
         )
         del corpus_bits
@@ -266,14 +273,16 @@ def bench_ubinary(rows: int, dim: int, n_queries: int, top_k: int,
                 np.asarray(corpus_mm[lo:hi])
             )
         int8_build_secs = time.perf_counter() - t_q
-        codes = jax.device_put(code_host)
-        scales = jax.device_put(scale_host)
+        codes = jax.device_put(group_rows(code_host, SCAN_CHUNK_INT8))
+        scales = jax.device_put(group_rows(scale_host, SCAN_CHUNK_INT8))
         codes_gib = round(code_host.nbytes / 2**30, 3)
         del code_host, scale_host
         queries_dev = jnp.asarray(queries)
         measure(
             'int8_rescore',
-            lambda: int8_topk(queries_dev, codes, scales, oversample)[1],
+            lambda: int8_topk(
+                queries_dev, codes, scales, oversample, n_valid=rows
+            )[1],
             {'codes_gib': codes_gib,
              'build_secs': round(int8_build_secs, 1)},
         )
