@@ -30,6 +30,24 @@ The serving path's decode kernel is separate (``ops/paged_attention.py``).
 Reference parity note: the reference gets this op from flash-attn/SDPA
 inside HF models (``distllm/embed/encoders/auto.py:119-138``, faesm for
 ESM); this is the TPU-native equivalent (SURVEY.md section 2.4 N3).
+
+Routing policy (data: ``scripts/probe_encoder_matrix.py`` on a v5e,
+2026-07-31, ``chipback_r05/probe_encoder_matrix.log``; constant token
+budget B*S = 128k per forward):
+
+- bert-base S=160..512: kernel 538-557k tok/s vs XLA 364-445k
+  (+21-52%), and the kernel is FLAT across the bucket ladder where XLA
+  degrades with S — exactly the shape regime the embed bench serves.
+- esm2-650m S=256/512: kernel 78-81k vs XLA 47-62k (+27-72%).
+- modernbert-base S=256/512 (windowed bias): kernel 357k vs XLA
+  257-343k (+4-39%).
+- S=1024 rows at 650m/modernbert dims exceed the VMEM working-set gate
+  (shape_supported) and serve on XLA SDPA — 79k / 147k tok/s there.
+
+So ``'auto'`` = kernel wherever :func:`shape_supported` passes, XLA
+otherwise — the policy below implements exactly that, now measured
+rather than assumed (the r3 probe that saw a tie was timing the tunnel
+round trip, not the device).
 """
 
 from __future__ import annotations
