@@ -291,7 +291,41 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
         engine_cfg.attn_backend = backend
         # Fresh params per candidate: the engine owns (and may delete)
         # them for destructive HBM optimizations (relayout, quant cleanup).
-        params = mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
+        if quantization is not None and jax.default_backend() != 'cpu':
+            # Quantize on the HOST cpu device and ship only the codes:
+            # letting the engine quantize device-resident bf16 streams
+            # 14.5 GB D2H + 7.25 GB H2D through the serving tunnel
+            # (~2 GB/s) — most of the gen_q stage's 22-45 min warmup,
+            # which run 4 pushed past the stage timeout. The engine
+            # passes pre-quantized QTensor leaves through untouched.
+            import ml_dtypes
+
+            from distllm_tpu.ops.quantization import quantize_pytree
+
+            shapes = jax.eval_shape(
+                lambda: mistral.init_on_device(
+                    jax.random.PRNGKey(0), model_cfg
+                )
+            )
+            host_rng = np.random.default_rng(0)
+            np_dtype = {
+                'bfloat16': ml_dtypes.bfloat16, 'float32': np.float32,
+            }[model_cfg.dtype]
+
+            def _host_leaf(leaf):
+                return (
+                    host_rng.standard_normal(leaf.shape, dtype=np.float32)
+                    * 0.02
+                ).astype(np_dtype)
+
+            qtree = quantize_pytree(
+                jax.tree.map(_host_leaf, shapes),
+                mode=quantization,
+                out_dtype=model_cfg.dtype,
+            )
+            params = jax.device_put(qtree, jax.devices()[0])
+        else:
+            params = mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
         candidate = LLMEngine(
             model_cfg, params, _Tok(), engine_cfg, own_params=True
         )
