@@ -474,12 +474,24 @@ class LLMEngine:
                         moved = fill(moved, host[i], np.int32(i))
                     del host
                     _sync(moved)
+                elif on_device:
+                    # Compiled identity relayout, NOT device_put: on the
+                    # serving backend a device_put with an explicit
+                    # non-default Format can silently keep the source
+                    # layout (observed on the stacked f32 scale leaves,
+                    # bench run 5 — the cached auto-layout window then
+                    # rejects the params at dispatch). XLA always honors
+                    # out_shardings; donation bounds the transient to the
+                    # target buffer.
+                    moved = jax.jit(
+                        lambda a: a, donate_argnums=0, out_shardings=fmt
+                    )(leaf)
+                    moved_bytes += nbytes
+                    if moved_bytes > (1 << 30):
+                        _sync(moved)
+                        moved_bytes = 0
                 else:
                     moved = jax.device_put(leaf, fmt)
-                    if hasattr(leaf, 'delete') and moved is not leaf:
-                        leaf.delete()
-                    # Bound the transient: deletes only land once the
-                    # async relayout copies complete, so sync every ~1 GiB.
                     moved_bytes += nbytes
                     if moved_bytes > (1 << 30):
                         _sync(moved)
