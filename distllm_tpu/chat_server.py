@@ -8,6 +8,13 @@ single-delta SSE streaming; request extensions ``top_k`` and
 permissive CORS. FastAPI is unavailable in this environment, so the server
 is aiohttp.
 
+Observability surface (docs/observability.md):
+
+- ``GET /metrics`` — Prometheus text exposition of the process registry
+  (engine throughput, KV occupancy, queue depth, HTTP latency, ...);
+- ``GET /health`` — liveness plus uptime / in-flight / served counts;
+- ``GET /debug/traces?limit=N`` — most recent spans from the trace ring.
+
 Run: ``DISTLLM_CHAT_CONFIG=cfg.yaml python -m distllm_tpu.chat_server --port 8000``
 """
 
@@ -20,7 +27,14 @@ import os
 import time
 import uuid
 
+import distllm_tpu
 from distllm_tpu.chat import ChatAppConfig, ChatSession
+from distllm_tpu.observability import (
+    get_trace_buffer,
+    instruments,
+    render_prometheus,
+    span,
+)
 
 
 def _completion_payload(model: str, content: str) -> dict:
@@ -55,6 +69,13 @@ def build_app(config: ChatAppConfig):
     # thread-safe; concurrency comes from the engine's continuous batching,
     # not from parallel Python threads.
     executor = ThreadPoolExecutor(max_workers=1)
+    started_at = time.time()
+
+    # Known routes pre-register their latency/count series so the very
+    # first /metrics scrape already carries the full schema.
+    known_paths = ('/v1/chat/completions', '/health', '/metrics')
+    for path in known_paths:
+        instruments.HTTP_LATENCY.labels(path=path)
 
     def answer(messages, top_k, score_threshold):
         """Stateless per-request RAG (history comes from the client)."""
@@ -64,16 +85,18 @@ def build_app(config: ChatAppConfig):
         )
         contexts, scores = [], []
         if session.retriever is not None and latest:
-            results, _ = session.retriever.search(
-                latest, top_k=top_k, score_threshold=score_threshold
-            )
-            indices = results.total_indices[0]
-            contexts = (
-                session.retriever.get_texts(indices) if indices else []
-            )
-            scores = results.total_scores[0]
+            with span('chat-retrieve', top_k=top_k):
+                results, _ = session.retriever.search(
+                    latest, top_k=top_k, score_threshold=score_threshold
+                )
+                indices = results.total_indices[0]
+                contexts = (
+                    session.retriever.get_texts(indices) if indices else []
+                )
+                scores = results.total_scores[0]
         prompt = template.render(list(messages), contexts, scores)
-        return session.generator.generate([prompt])[0]
+        with span('chat-generate'):
+            return session.generator.generate([prompt])[0]
 
     async def chat_completions(request: 'web.Request') -> 'web.StreamResponse':
         body = await request.json()
@@ -122,14 +145,62 @@ def build_app(config: ChatAppConfig):
         return web.json_response(_completion_payload(model, content))
 
     async def health(request: 'web.Request') -> 'web.Response':
-        return web.json_response({'status': 'ok'})
+        # In-flight includes this very request; report the others.
+        in_flight = max(0, int(instruments.HTTP_IN_FLIGHT.value) - 1)
+        return web.json_response(
+            {
+                'status': 'ok',
+                'version': distllm_tpu.__version__,
+                'uptime_s': round(time.time() - started_at, 3),
+                'in_flight': in_flight,
+                'requests_served': int(instruments.HTTP_RESPONSES.value),
+            }
+        )
+
+    async def metrics(request: 'web.Request') -> 'web.Response':
+        return web.Response(
+            body=render_prometheus().encode('utf-8'),
+            headers={
+                'Content-Type': 'text/plain; version=0.0.4; charset=utf-8'
+            },
+        )
+
+    async def traces(request: 'web.Request') -> 'web.Response':
+        try:
+            limit = int(request.query.get('limit', '100'))
+        except ValueError:
+            return web.json_response(
+                {'error': {'message': 'limit must be an integer'}}, status=400
+            )
+        spans = get_trace_buffer().snapshot(limit=max(1, limit))
+        return web.json_response(
+            {'spans': [s.to_dict() for s in spans if s.end_ns is not None]}
+        )
 
     async def preflight(request: 'web.Request') -> 'web.Response':
         return web.Response(status=204)
 
     @web.middleware
     async def cors(request, handler):
-        response = await handler(request)
+        path = request.path if request.path in known_paths else 'other'
+        instruments.HTTP_IN_FLIGHT.inc()
+        start = time.perf_counter()
+        status = 500
+        try:
+            response = await handler(request)
+            status = response.status
+        except web.HTTPException as exc:
+            status = exc.status
+            raise
+        finally:
+            instruments.HTTP_IN_FLIGHT.dec()
+            instruments.HTTP_LATENCY.labels(path=path).observe(
+                time.perf_counter() - start
+            )
+            instruments.HTTP_REQUESTS.labels(
+                path=path, status=f'{status // 100}xx'
+            ).inc()
+            instruments.HTTP_RESPONSES.inc()
         response.headers['Access-Control-Allow-Origin'] = '*'
         response.headers['Access-Control-Allow-Headers'] = '*'
         response.headers['Access-Control-Allow-Methods'] = 'GET, POST, OPTIONS'
@@ -138,6 +209,8 @@ def build_app(config: ChatAppConfig):
     app = web.Application(middlewares=[cors])
     app.router.add_post('/v1/chat/completions', chat_completions)
     app.router.add_get('/health', health)
+    app.router.add_get('/metrics', metrics)
+    app.router.add_get('/debug/traces', traces)
     # Browser preflight for any path (CORS headers added by the middleware).
     app.router.add_route('OPTIONS', '/{tail:.*}', preflight)
     return app

@@ -8,6 +8,7 @@ from __future__ import annotations
 import argparse
 
 from distllm_tpu.cli import subcommand
+from distllm_tpu.observability.instruments import log_event
 
 
 @subcommand('version', 'Print the distllm-tpu version.')
@@ -15,7 +16,7 @@ def _version(parser: argparse.ArgumentParser):
     def run(args: argparse.Namespace) -> int:
         import distllm_tpu
 
-        print(distllm_tpu.__version__)
+        log_event(distllm_tpu.__version__, component='cli')
         return 0
 
     return run
@@ -86,10 +87,13 @@ def _merge(parser: argparse.ArgumentParser):
             p for p in Path(args.dataset_dir).iterdir() if p.is_dir()
         )
         if not shards:
-            print(f'No shard dirs in {args.dataset_dir}')
+            log_event(f'No shard dirs in {args.dataset_dir}', component='cli')
             return 1
         writer.merge(shards, args.output_dir)
-        print(f'Merged {len(shards)} shards -> {args.output_dir}')
+        log_event(
+            f'Merged {len(shards)} shards -> {args.output_dir}',
+            component='cli',
+        )
         return 0
 
     return run
@@ -181,7 +185,7 @@ def _chunk_fasta(parser: argparse.ArgumentParser):
 
         sequences = read_fasta(args.fasta_file)
         if not sequences:
-            print(f'No sequences found in {args.fasta_file}')
+            log_event(f'No sequences found in {args.fasta_file}', component='cli')
             return 1
         out = Path(args.output_dir)
         out.mkdir(parents=True, exist_ok=True)
@@ -192,7 +196,10 @@ def _chunk_fasta(parser: argparse.ArgumentParser):
             write_fasta(
                 sequences[i : i + per], out / f'{stem}.chunk{i // per:04d}.fasta'
             )
-        print(f'Wrote {(len(sequences) + per - 1) // per} chunks to {out}')
+        log_event(
+            f'Wrote {(len(sequences) + per - 1) // per} chunks to {out}',
+            component='cli',
+        )
         return 0
 
     return run

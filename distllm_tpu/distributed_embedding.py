@@ -17,6 +17,7 @@ import uuid
 from pathlib import Path
 from typing import Any
 
+from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.parallel.fabric import map_with_teardown
 from distllm_tpu.parallel.launcher import ComputeConfigs, LocalConfig
 from distllm_tpu.timer import Timer
@@ -87,9 +88,13 @@ def run_embedding(config: Config) -> int:
     for pattern in config.glob_patterns:
         files.extend(str(p) for p in sorted(config.input_dir.glob(pattern)))
     if not files:
-        print(f'No input files matched {config.glob_patterns} in {config.input_dir}')
+        log_event(
+            f'No input files matched {config.glob_patterns} in '
+            f'{config.input_dir}',
+            component='embed',
+        )
         return 1
-    print(f'Embedding {len(files)} files -> {embedding_dir}')
+    log_event(f'Embedding {len(files)} files -> {embedding_dir}', component='embed')
 
     worker_fn = functools.partial(
         # Run as `python -m`, this module is __main__; rebind the
@@ -105,7 +110,7 @@ def run_embedding(config: Config) -> int:
     )
     executor = config.compute_config.get_executor(config.output_dir / 'run')
     shards = map_with_teardown(executor, worker_fn, files)
-    print(f'Finished: {len(shards)} shards written')
+    log_event(f'Finished: {len(shards)} shards written', component='embed')
     return 0
 
 

@@ -17,6 +17,7 @@ import uuid
 from pathlib import Path
 from typing import Any
 
+from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.parallel.fabric import map_with_teardown
 from distllm_tpu.parallel.launcher import ComputeConfigs, LocalConfig
 from distllm_tpu.timer import Timer
@@ -83,9 +84,10 @@ class Config(BaseConfig):
 def run_generation(config: Config) -> int:
     if config.output_dir.exists():
         # Clobber guard (reference :115-121).
-        print(
+        log_event(
             f'Output directory {config.output_dir} already exists; refusing '
-            'to overwrite a finished run.'
+            'to overwrite a finished run.',
+            component='generate',
         )
         return 1
     generation_dir = config.output_dir / 'generations'
@@ -96,9 +98,16 @@ def run_generation(config: Config) -> int:
     for pattern in config.glob_patterns:
         files.extend(str(p) for p in sorted(config.input_dir.glob(pattern)))
     if not files:
-        print(f'No input files matched {config.glob_patterns} in {config.input_dir}')
+        log_event(
+            f'No input files matched {config.glob_patterns} in '
+            f'{config.input_dir}',
+            component='generate',
+        )
         return 1
-    print(f'Generating over {len(files)} files -> {generation_dir}')
+    log_event(
+        f'Generating over {len(files)} files -> {generation_dir}',
+        component='generate',
+    )
 
     worker_fn = functools.partial(
         # Run as `python -m`, this module is __main__; rebind the
@@ -113,7 +122,7 @@ def run_generation(config: Config) -> int:
     )
     executor = config.compute_config.get_executor(config.output_dir / 'run')
     shards = map_with_teardown(executor, worker_fn, files)
-    print(f'Finished: {len(shards)} shards written')
+    log_event(f'Finished: {len(shards)} shards written', component='generate')
     return 0
 
 

@@ -16,6 +16,7 @@ from typing import Literal
 from pydantic import Field
 
 from distllm_tpu.embed.embedders.base import EmbedderResult
+from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.utils import BaseConfig
 
 
@@ -54,7 +55,10 @@ class HuggingFaceWriter:
             try:
                 shards.append(load_from_disk(str(path)))
             except Exception as exc:  # noqa: BLE001 - skip bad shards
-                print(f'[writer] skipping shard {path}: {exc}')
+                log_event(
+                    f'[writer] skipping shard {path}: {exc}',
+                    component='writer',
+                )
         if not shards:
             raise ValueError(f'no readable shards among {len(dataset_dirs)} dirs')
         merged = concatenate_datasets(shards)

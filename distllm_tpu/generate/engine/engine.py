@@ -40,11 +40,13 @@ from pydantic import field_validator
 
 from distllm_tpu.generate.engine.kv_cache import PagedKVCache
 from distllm_tpu.generate.engine.scheduler import (
+    InstrumentedScheduler,
     SchedulerExhausted,
     make_scheduler,
 )
 from distllm_tpu.models import mistral
 from distllm_tpu.models.tokenizer import bucket_ladder, pick_bucket
+from distllm_tpu.observability import instruments as _metrics
 from distllm_tpu.ops.sampling import sample_tokens
 from distllm_tpu.utils import BaseConfig
 
@@ -220,12 +222,16 @@ class LLMEngine:
         )
 
         # All admission / preemption / block-budget decisions live in the
-        # scheduler (native C++ core, Python twin fallback).
-        self.sched = make_scheduler(
-            cfg.num_blocks,
-            cfg.block_size,
-            cfg.max_num_seqs,
-            prefer_native=cfg.prefer_native_allocator,
+        # scheduler (native C++ core, Python twin fallback); the wrapper
+        # publishes queue depth / occupancy / admit-defer-preempt metrics.
+        self.sched = InstrumentedScheduler(
+            make_scheduler(
+                cfg.num_blocks,
+                cfg.block_size,
+                cfg.max_num_seqs,
+                prefer_native=cfg.prefer_native_allocator,
+            ),
+            num_blocks=cfg.num_blocks,
         )
         self._requests: dict[int, Request] = {}
         self._next_id = itertools.count()
@@ -591,6 +597,8 @@ class LLMEngine:
         )
         self._requests[request.request_id] = request
         self.sched.add(request.request_id, request.num_tokens)
+        _metrics.ENGINE_REQUESTS_ADDED.inc()
+        _metrics.ENGINE_PROMPT_TOKENS.inc(len(prompt_ids))
         return request.request_id
 
     @property
@@ -631,6 +639,7 @@ class LLMEngine:
                 cap = self._prefill_batch_cap(bucket)
                 for i in range(0, len(requests), cap):
                     self._stats['prefill_dispatches'] += 1
+                    _metrics.ENGINE_PREFILL_DISPATCHES.inc()
                     emitted.extend(
                         self._run_prefill_batch(
                             requests[i : i + cap], bucket, defer_to
@@ -672,6 +681,7 @@ class LLMEngine:
         length 0: their K/V scatter lands in trash block 0 and their
         sampled token is discarded.
         """
+        _metrics.ENGINE_PREFILL_BATCH.observe(len(requests))
         b = 1
         while b < len(requests):
             b *= 2
@@ -920,6 +930,10 @@ class LLMEngine:
             if steps:
                 self._unacked[rid] = self._unacked.get(rid, 0) + steps
         self._stats['decode_windows'] += 1
+        _metrics.ENGINE_DECODE_WINDOWS.inc()
+        _metrics.ENGINE_DECODE_UTILIZATION.observe(
+            sum(1 for _, _, steps in plan if steps > 0) / b
+        )
         return {'tokens': tokens, 'plan': plan, 'last_ids': last_ids}
 
     def _process_window(self, window: dict) -> list[tuple[int, int]]:
@@ -935,6 +949,7 @@ class LLMEngine:
                 self._unacked[rid] = max(0, self._unacked[rid] - steps)
             if rid not in self._requests:
                 self._stats['overshoot_tokens'] += steps
+                _metrics.ENGINE_OVERSHOOT_TOKENS.inc(steps)
                 continue  # finished in an earlier window; overshoot tokens
             request = self._requests[rid]
             if request.state is not RequestState.RUNNING:
@@ -945,6 +960,7 @@ class LLMEngine:
                 emitted.append((rid, token))
                 if rid not in self._requests:
                     self._stats['overshoot_tokens'] += steps - i - 1
+                    _metrics.ENGINE_OVERSHOOT_TOKENS.inc(steps - i - 1)
                     break  # finished mid-window
         return emitted
 
@@ -1024,6 +1040,7 @@ class LLMEngine:
         # fed as input on the next decode step, which writes it then.
         request.output_ids.append(token)
         self.sched.append_token(request.request_id)
+        _metrics.ENGINE_GENERATED_TOKENS.inc()
         eos = getattr(self.tokenizer, 'eos_id', None)
         stops = set(request.params.stop_token_ids)
         if eos is not None:
@@ -1037,6 +1054,7 @@ class LLMEngine:
 
     def _finish(self, request: Request) -> None:
         request.state = RequestState.FINISHED
+        _metrics.ENGINE_REQUESTS_FINISHED.inc()
         self.sched.finish(request.request_id)
         self._unacked.pop(request.request_id, None)
         del self._requests[request.request_id]

@@ -153,6 +153,8 @@ class PagedKVCache:
         engine can run transient-heavy weight migrations first)."""
         if self.k is not None:
             return
+        from distllm_tpu.observability import instruments
+
         if self._sharding is None:
             self.k = jnp.zeros(self.shape, dtype=self.dtype)
             self.v = jnp.zeros(self.shape, dtype=self.dtype)
@@ -166,6 +168,7 @@ class PagedKVCache:
             )
             self.k = zeros()
             self.v = zeros()
+        instruments.KV_HBM_BYTES.set(self.hbm_bytes)
 
     def spec(self):
         """ShapeDtypeStruct for one pool array (AOT compilation input)."""

@@ -349,6 +349,98 @@ class NativeScheduler:
             self._handle = None
 
 
+class InstrumentedScheduler:
+    """Delegating wrapper that publishes scheduler state as metrics.
+
+    Wraps either implementation (policy untouched — the differential tests
+    drive the raw classes) and keeps the process-wide gauges/counters in
+    ``observability.instruments`` current on every mutating call: queue
+    depth, running slots, KV-block occupancy, admit/defer decisions, and
+    preemptions. Gauges are process-wide; with several engines in one
+    process the last mutator wins (serving runs one engine per process).
+    """
+
+    def __init__(self, inner: Scheduler, num_blocks: int) -> None:
+        from distllm_tpu.observability import instruments
+
+        self._inner = inner
+        self._m = instruments
+        self._usable_blocks = num_blocks - 1  # block 0 is reserved
+        self._m.KV_BLOCKS_TOTAL.set(self._usable_blocks)
+        self._sync()
+
+    def _sync(self) -> None:
+        in_use = self._usable_blocks - self._inner.num_free_blocks
+        self._m.KV_BLOCKS_IN_USE.set(in_use)
+        self._m.KV_OCCUPANCY.set(
+            in_use / self._usable_blocks if self._usable_blocks else 0.0
+        )
+        self._m.SCHED_QUEUE_DEPTH.set(self._inner.num_waiting)
+        self._m.SCHED_RUNNING.set(self._inner.num_running)
+
+    def add(self, rid: int, num_tokens: int) -> None:
+        self._inner.add(rid, num_tokens)
+        self._sync()
+
+    def admit_next(self) -> int | None:
+        rid = self._inner.admit_next()
+        if rid is not None:
+            self._m.SCHED_ADMITTED.inc()
+            self._sync()
+        elif self._inner.num_waiting:
+            self._m.SCHED_DEFERRED.inc()
+        return rid
+
+    def prepare_decode(self, k: int = 1) -> list[int]:
+        try:
+            preempted = self._inner.prepare_decode(k)
+        except SchedulerExhausted as exc:
+            # Preemptions performed before the fatal exhaustion still
+            # happened; count them before propagating.
+            if exc.preempted:
+                self._m.SCHED_PREEMPTIONS.inc(len(exc.preempted))
+            self._sync()
+            raise
+        if preempted:
+            self._m.SCHED_PREEMPTIONS.inc(len(preempted))
+        self._sync()
+        return preempted
+
+    def append_token(self, rid: int) -> None:
+        # No _sync: appending only bumps the token count — block
+        # allocation happens in prepare_decode, which does sync.
+        self._inner.append_token(rid)
+
+    def finish(self, rid: int) -> None:
+        self._inner.finish(rid)
+        self._sync()
+
+    def slot(self, rid: int) -> int:
+        return self._inner.slot(rid)
+
+    def running(self) -> list[tuple[int, int]]:
+        return self._inner.running()
+
+    def block_row(self, rid: int) -> list[int]:
+        return self._inner.block_row(rid)
+
+    @property
+    def num_free_blocks(self) -> int:
+        return self._inner.num_free_blocks
+
+    @property
+    def num_running(self) -> int:
+        return self._inner.num_running
+
+    @property
+    def num_waiting(self) -> int:
+        return self._inner.num_waiting
+
+    @property
+    def has_unfinished(self) -> bool:
+        return self._inner.has_unfinished
+
+
 def make_scheduler(
     num_blocks: int,
     block_size: int,

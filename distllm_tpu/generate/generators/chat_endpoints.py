@@ -26,6 +26,7 @@ from typing import Literal
 from pydantic import Field
 
 from distllm_tpu.generate.generators.api_backend import ApiAuthError
+from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.utils import BaseConfig, expo_backoff_retry
 
 _SYSTEM = 'You are a helpful assistant.'
@@ -110,7 +111,7 @@ class ArgoGenerator(_ChatEndpointBase):
             payload = self._post(url, headers, body)
             return payload['choices'][0]['message']['content']
         except Exception as exc:  # reference returns, not raises (:252-257)
-            print(f'Error calling Argo proxy: {exc}')
+            log_event(f'Error calling Argo proxy: {exc}', component='generate')
             return f'Error: {exc!s}'
 
     def generate(
@@ -176,7 +177,7 @@ class OpenAIAPIGenerator(_ChatEndpointBase):
                 return f'[No content returned. Finish reason: {reason}]'
             return content
         except Exception as exc:
-            print(f'Error calling OpenAI API: {exc}')
+            log_event(f'Error calling OpenAI API: {exc}', component='generate')
             return f'Error: {exc}'
 
     def generate(

@@ -9,6 +9,7 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Literal
 
+from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.utils import BaseConfig
 
 
@@ -44,7 +45,10 @@ class HuggingFaceWriter:
             try:
                 shards.append(load_from_disk(str(path)))
             except Exception as exc:  # noqa: BLE001 - skip bad shards
-                print(f'[writer] skipping shard {path}: {exc}')
+                log_event(
+                    f'[writer] skipping shard {path}: {exc}',
+                    component='writer',
+                )
         if not shards:
             raise ValueError(f'no readable shards among {len(dataset_dirs)} dirs')
         concatenate_datasets(shards).save_to_disk(

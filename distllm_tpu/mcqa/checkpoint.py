@@ -15,6 +15,8 @@ import time
 from pathlib import Path
 from typing import Any
 
+from distllm_tpu.observability.instruments import log_event
+
 CHECKPOINT_VERSION = 1
 
 
@@ -89,9 +91,10 @@ class CheckpointManager:
             if results is not None:
                 with self._lock:
                     self.results = results
-                print(
+                log_event(
                     f'[checkpoint] resumed {len(results)} results '
-                    f'from {path.name}'
+                    f'from {path.name}',
+                    component='checkpoint',
                 )
                 return len(results)
         return 0
@@ -100,17 +103,21 @@ class CheckpointManager:
         try:
             payload = json.loads(path.read_text())
         except (json.JSONDecodeError, OSError):
-            print(f'[checkpoint] ignoring corrupt {path}')
+            log_event(f'[checkpoint] ignoring corrupt {path}', component='checkpoint')
             return None
         if payload.get('version') != CHECKPOINT_VERSION:
-            print(f'[checkpoint] version mismatch in {path}; ignoring')
+            log_event(
+                f'[checkpoint] version mismatch in {path}; ignoring',
+                component='checkpoint',
+            )
             return None
         meta = payload.get('metadata', {})
         for key in ('model', 'questions_file'):
             if key in self.metadata and meta.get(key) != self.metadata[key]:
-                print(
+                log_event(
                     f'[checkpoint] {key} mismatch in {path.name} '
-                    f'({meta.get(key)!r} != {self.metadata[key]!r}); ignoring'
+                    f'({meta.get(key)!r} != {self.metadata[key]!r}); ignoring',
+                    component='checkpoint',
                 )
                 return None
         return {int(k): v for k, v in payload.get('results', {}).items()}

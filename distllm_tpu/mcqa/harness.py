@@ -25,6 +25,7 @@ from distllm_tpu.mcqa.batching import BatchingClient
 from distllm_tpu.mcqa.checkpoint import CheckpointManager
 from distllm_tpu.mcqa.config import MCQAConfig
 from distllm_tpu.mcqa.grading import grade_answer
+from distllm_tpu.observability.instruments import log_event
 
 
 # --------------------------------------------------------------- chunk ids
@@ -52,7 +53,7 @@ class _PlainProgress:
         with self._lock:
             self.count += n
             if self.count % max(1, self.total // 20) == 0 or self.count == self.total:
-                print(f'[mcqa] {self.count}/{self.total}', flush=True)
+                log_event(f'[mcqa] {self.count}/{self.total}', component='mcqa')
 
     def close(self) -> None:
         pass
@@ -271,7 +272,10 @@ def run_mcqa(config: MCQAConfig) -> dict[str, Any]:
         i for i in range(len(questions))
         if i not in checkpoints.completed_indices
     ]
-    print(f'[mcqa] {len(todo)}/{len(questions)} questions to process')
+    log_event(
+        f'[mcqa] {len(todo)}/{len(questions)} questions to process',
+        component='mcqa',
+    )
 
     progress = _progress(len(todo))
     start_time = time.perf_counter()
@@ -345,7 +349,10 @@ def run_mcqa(config: MCQAConfig) -> dict[str, Any]:
     (config.output_dir / 'incorrect_answers.json').write_text(
         json.dumps(incorrect, indent=2)
     )
-    print(f'[mcqa] accuracy={summary["accuracy"]:.3f} ({correct}/{len(graded)})')
+    log_event(
+        f'[mcqa] accuracy={summary["accuracy"]:.3f} ({correct}/{len(graded)})',
+        component='mcqa',
+    )
     return summary
 
 

@@ -1,0 +1,57 @@
+"""Engine-to-endpoint metrics & tracing (ISSUE 1 tentpole).
+
+Three layers, all dependency-free:
+
+- :mod:`~distllm_tpu.observability.metrics` — ``Counter`` / ``Gauge`` /
+  ``Histogram`` registry with Prometheus text exposition;
+- :mod:`~distllm_tpu.observability.tracing` — ``Span`` records + a bounded
+  in-memory trace ring dumpable to JSONL (``timer.Timer`` is a shim over
+  this: every timer emits both the legacy ``[timer]`` line and a span);
+- :mod:`~distllm_tpu.observability.instruments` — the catalog of well-known
+  series (engine, KV cache, scheduler, HTTP, fabric workers) plus the
+  ``log_event`` stdout funnel.
+
+``aggregate`` (imported lazily to avoid a cycle with ``timer``) rolls
+multi-host ``[timer]`` logs into one stats table. Metric names and
+conventions are documented in ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+from distllm_tpu.observability.instruments import log_event
+from distllm_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    log_buckets,
+    render_prometheus,
+)
+from distllm_tpu.observability.tracing import (
+    Span,
+    TraceBuffer,
+    begin_span,
+    dump_traces,
+    end_span,
+    get_trace_buffer,
+    span,
+)
+
+__all__ = [
+    'Counter',
+    'Gauge',
+    'Histogram',
+    'MetricsRegistry',
+    'Span',
+    'TraceBuffer',
+    'begin_span',
+    'dump_traces',
+    'end_span',
+    'get_registry',
+    'get_trace_buffer',
+    'log_buckets',
+    'log_event',
+    'render_prometheus',
+    'span',
+]

@@ -1,0 +1,90 @@
+"""Roll up multi-host worker logs into one stats table.
+
+Fabric workers on every TPU host emit ``[timer]`` lines (see ``timer.py``)
+into their own stdout/log files. This module merges any number of those
+captures into a single ``{tags: TimeStats}`` view — the multi-host
+aggregation the reference could only do by hand — and renders it as a
+fixed-width table whose columns (count / total / mean / p50 / p95 / max)
+match what ``distllm_stage_duration_seconds`` exposes over ``/metrics``.
+
+CLI::
+
+    python -m distllm_tpu.observability.aggregate run/logs/*.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+
+def aggregate_lines(captures: list[str]) -> dict[tuple[str, ...], object]:
+    """Merge multiple log captures (strings) into one stats dict."""
+    # Lazy import: timer.py imports this package at module load.
+    from distllm_tpu.timer import TimeLogger, TimeStats
+
+    logger = TimeLogger()
+    merged: dict[tuple[str, ...], TimeStats] = {}
+    for capture in captures:
+        for tags, stats in logger.parse_lines(capture).items():
+            entry = merged.setdefault(tags, TimeStats(tags=tags))
+            entry.elapsed_s.extend(stats.elapsed_s)
+            entry.start_ns.extend(stats.start_ns)
+            entry.end_ns.extend(stats.end_ns)
+    return merged
+
+
+def aggregate_logs(paths: list[str | Path]) -> dict[tuple[str, ...], object]:
+    """Merge ``[timer]`` lines from many log files into one stats dict."""
+    return aggregate_lines([Path(p).read_text() for p in paths])
+
+
+def format_stats_table(stats: dict[tuple[str, ...], object]) -> str:
+    """Fixed-width table, one row per tag set, sorted by total time desc."""
+    header = ('tags', 'count', 'total_s', 'mean_s', 'p50_s', 'p95_s', 'max_s')
+    rows = [header]
+    ordered = sorted(
+        stats.values(), key=lambda s: s.total_s, reverse=True
+    )
+    for entry in ordered:
+        rows.append(
+            (
+                ','.join(entry.tags) or '-',
+                str(entry.count),
+                f'{entry.total_s:.3f}',
+                f'{entry.mean_s:.3f}',
+                f'{entry.p50_s:.3f}',
+                f'{entry.p95_s:.3f}',
+                f'{entry.max_s:.3f}',
+            )
+        )
+    widths = [max(len(row[i]) for row in rows) for i in range(len(header))]
+    lines = []
+    for i, row in enumerate(rows):
+        lines.append(
+            '  '.join(cell.ljust(widths[j]) for j, cell in enumerate(row)).rstrip()
+        )
+        if i == 0:
+            lines.append('  '.join('-' * w for w in widths))
+    return '\n'.join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    from distllm_tpu.observability.instruments import log_event
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('logs', nargs='+', type=Path, help='worker log files')
+    args = parser.parse_args(argv)
+    stats = aggregate_logs(args.logs)
+    if not stats:
+        log_event(
+            f'No [timer] lines found in {len(args.logs)} files',
+            component='aggregate',
+        )
+        return 1
+    log_event(format_stats_table(stats), component='aggregate')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

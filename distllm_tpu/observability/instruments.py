@@ -1,0 +1,159 @@
+"""Catalog of the well-known metric series (name = contract).
+
+Every series the serving stack emits is declared here, in one place, so (a)
+``docs/observability.md`` has a single source of truth, (b) importing this
+module pre-registers the engine/scheduler/KV series with zero values —
+``GET /metrics`` exposes the full schema from the first scrape, before any
+traffic — and (c) call sites cannot typo a metric name into a fresh series.
+
+Naming follows Prometheus conventions: ``distllm_`` prefix, ``_total``
+suffix on counters, base units (seconds, bytes, ratios in [0, 1]).
+"""
+
+from __future__ import annotations
+
+from distllm_tpu.observability.metrics import get_registry, log_buckets
+
+_registry = get_registry()
+
+# --------------------------------------------------------------- engine
+ENGINE_GENERATED_TOKENS = _registry.counter(
+    'distllm_engine_generated_tokens_total',
+    'Tokens emitted by the generation engine (token throughput source).',
+)
+ENGINE_PROMPT_TOKENS = _registry.counter(
+    'distllm_engine_prompt_tokens_total',
+    'Prompt tokens accepted into the engine via add_request.',
+)
+ENGINE_REQUESTS_ADDED = _registry.counter(
+    'distllm_engine_requests_added_total',
+    'Requests submitted to the engine.',
+)
+ENGINE_REQUESTS_FINISHED = _registry.counter(
+    'distllm_engine_requests_finished_total',
+    'Requests that reached a stop condition.',
+)
+ENGINE_PREFILL_DISPATCHES = _registry.counter(
+    'distllm_engine_prefill_dispatches_total',
+    'Batched prefill dispatches (one padded jit call each).',
+)
+ENGINE_DECODE_WINDOWS = _registry.counter(
+    'distllm_engine_decode_windows_total',
+    'Fused decode-window dispatches.',
+)
+ENGINE_OVERSHOOT_TOKENS = _registry.counter(
+    'distllm_engine_overshoot_tokens_total',
+    'Post-EOS tokens discarded by the pipelined one-window-late design.',
+)
+ENGINE_PREFILL_BATCH = _registry.histogram(
+    'distllm_engine_prefill_batch_size',
+    'Requests per batched prefill dispatch (padding rows excluded).',
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+ENGINE_DECODE_UTILIZATION = _registry.histogram(
+    'distllm_engine_decode_window_utilization',
+    'Fraction of decode-window slots generating tokens (batch occupancy).',
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+)
+
+# ------------------------------------------------------------- KV cache
+KV_BLOCKS_TOTAL = _registry.gauge(
+    'distllm_kv_cache_blocks_total',
+    'Allocatable KV-cache blocks (pool size minus the reserved trash block).',
+)
+KV_BLOCKS_IN_USE = _registry.gauge(
+    'distllm_kv_cache_blocks_in_use',
+    'KV-cache blocks currently owned by running/admitted sequences.',
+)
+KV_OCCUPANCY = _registry.gauge(
+    'distllm_kv_cache_occupancy_ratio',
+    'KV-cache block occupancy, in_use / total (0..1).',
+)
+KV_HBM_BYTES = _registry.gauge(
+    'distllm_kv_cache_hbm_bytes',
+    'Device memory held by the paged K/V pool arrays.',
+)
+
+# ------------------------------------------------------------ scheduler
+SCHED_QUEUE_DEPTH = _registry.gauge(
+    'distllm_scheduler_queue_depth',
+    'Requests waiting for admission (continuous-batching backlog).',
+)
+SCHED_RUNNING = _registry.gauge(
+    'distllm_scheduler_running_requests',
+    'Requests currently holding a decode slot.',
+)
+SCHED_ADMITTED = _registry.counter(
+    'distllm_scheduler_admitted_total',
+    'Waiting requests admitted to a decode slot.',
+)
+SCHED_DEFERRED = _registry.counter(
+    'distllm_scheduler_deferred_total',
+    'Admission attempts deferred (no free slot or insufficient blocks).',
+)
+SCHED_PREEMPTIONS = _registry.counter(
+    'distllm_scheduler_preemptions_total',
+    'Running requests recompute-preempted back to the waiting queue.',
+)
+
+# ------------------------------------------------- pipeline stages (Timer)
+STAGE_SECONDS = _registry.histogram(
+    'distllm_stage_duration_seconds',
+    'Per-stage wall time from timer.Timer spans, labeled by lead tag.',
+    labelnames=('stage', 'status'),
+)
+
+# ----------------------------------------------------------- HTTP server
+HTTP_REQUESTS = _registry.counter(
+    'distllm_http_requests_total',
+    'HTTP requests served, by normalized path and status class.',
+    labelnames=('path', 'status'),
+)
+HTTP_LATENCY = _registry.histogram(
+    'distllm_http_request_duration_seconds',
+    'End-to-end request latency, by normalized path.',
+    labelnames=('path',),
+    buckets=log_buckets(1e-3, 300.0),
+)
+HTTP_IN_FLIGHT = _registry.gauge(
+    'distllm_http_requests_in_flight',
+    'Requests currently being handled.',
+)
+HTTP_RESPONSES = _registry.counter(
+    'distllm_http_responses_total',
+    'Responses completed by this server process (all paths).',
+)
+
+# -------------------------------------------------------- fabric workers
+WORKER_HEARTBEATS = _registry.counter(
+    'distllm_worker_heartbeats_total',
+    'Heartbeats sent by this fabric worker.',
+)
+WORKER_TASKS = _registry.counter(
+    'distllm_worker_tasks_total',
+    'Fabric tasks executed, by outcome.',
+    labelnames=('outcome',),
+)
+WORKER_TASK_SECONDS = _registry.histogram(
+    'distllm_worker_task_duration_seconds',
+    'Wall time per fabric task (heartbeats excluded).',
+)
+
+# ------------------------------------------------------------ log funnel
+LOG_MESSAGES = _registry.counter(
+    'distllm_log_messages_total',
+    'Operator log lines emitted through observability.log_event.',
+    labelnames=('component',),
+)
+
+
+def log_event(message: str, *, component: str = 'app') -> None:
+    """The sanctioned stdout funnel: print + count.
+
+    All operator-facing telemetry lines in ``distllm_tpu`` go through here
+    (``tests/test_lint.py`` forbids raw ``print(`` outside ``timer.py`` and
+    this package), so every emitted line is also visible as
+    ``distllm_log_messages_total{component=...}`` in scrapes.
+    """
+    LOG_MESSAGES.labels(component=component).inc()
+    print(message, flush=True)

@@ -28,6 +28,9 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
+from distllm_tpu.observability import instruments, tracing
+from distllm_tpu.observability.instruments import log_event
+
 _READY = b'READY'
 _HEARTBEAT = b'HB'
 _RESULT = b'RESULT'
@@ -231,6 +234,7 @@ class FabricWorker:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
             self._send([_HEARTBEAT])
+            instruments.WORKER_HEARTBEATS.inc()
 
     def run(self) -> None:
         import zmq
@@ -245,10 +249,10 @@ class FabricWorker:
             events = dict(poller.poll(timeout=500))
             if self._socket not in events:
                 if time.monotonic() - last_contact > self.idle_timeout:
-                    print(
+                    log_event(
                         f'[worker] no coordinator contact for '
                         f'{self.idle_timeout:.0f}s; exiting',
-                        flush=True,
+                        component='worker',
                     )
                     break
                 continue
@@ -258,13 +262,21 @@ class FabricWorker:
                 if payload == _SHUTDOWN:
                     break
                 continue
+            task_start = time.monotonic()
             try:
-                fn, args, kwargs = pickle.loads(payload)
-                result = fn(*args, **kwargs)
+                with tracing.span('fabric-task', task_id.hex()):
+                    fn, args, kwargs = pickle.loads(payload)
+                    result = fn(*args, **kwargs)
+                instruments.WORKER_TASKS.labels(outcome='ok').inc()
                 self._send([_RESULT, task_id, b'1', pickle.dumps(result)])
             except BaseException as exc:  # noqa: BLE001 - shipped to coordinator
+                instruments.WORKER_TASKS.labels(outcome='error').inc()
                 self._send(
                     [_RESULT, task_id, b'0', pickle.dumps(RuntimeError(repr(exc)))]
+                )
+            finally:
+                instruments.WORKER_TASK_SECONDS.observe(
+                    time.monotonic() - task_start
                 )
         self._stop.set()  # ends the heartbeat thread on poison-pill exit
 

@@ -25,6 +25,7 @@ from typing import Any, Callable, Iterable, Literal, Union
 
 from pydantic import Field
 
+from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.utils import BaseConfig
 
 
@@ -106,7 +107,7 @@ class PodConfig(BaseConfig):
             heartbeat_threshold=self.heartbeat_threshold,
             advertise_host=self.advertise_host,
         )
-        print(f'[fabric] coordinator at {coordinator.endpoint}', flush=True)
+        log_event(f'[fabric] coordinator at {coordinator.endpoint}', component='fabric')
         return ZmqPoolExecutor(coordinator)
 
 
@@ -188,7 +189,7 @@ class _BatchSchedulerConfig(BaseConfig):
         script = self.render_script(coordinator.endpoint, run_dir)
         script_path = run_dir / self._script_name
         script_path.write_text(script)
-        print(f'[fabric] coordinator at {coordinator.endpoint}', flush=True)
+        log_event(f'[fabric] coordinator at {coordinator.endpoint}', component='fabric')
         if self.submit:
             proc = subprocess.run(
                 self._submit_command(script_path),
@@ -200,7 +201,7 @@ class _BatchSchedulerConfig(BaseConfig):
                     f'job submission failed ({proc.returncode}): '
                     f'{proc.stderr.strip()[-500:]}'
                 )
-            print(f'[fabric] submitted job: {proc.stdout.strip()}', flush=True)
+            log_event(f'[fabric] submitted job: {proc.stdout.strip()}', component='fabric')
         return ZmqPoolExecutor(coordinator)
 
 

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import argparse
 
+from distllm_tpu.observability.instruments import log_event
+
 
 def main(argv: list[str] | None = None) -> int:
     from distllm_tpu.utils import apply_platform_env
@@ -43,7 +45,7 @@ def main(argv: list[str] | None = None) -> int:
         from distllm_tpu.parallel.multihost import init_multihost
 
         rank, size = init_multihost()
-        print(f'[worker] jax runtime rank {rank}/{size}', flush=True)
+        log_event(f'[worker] jax runtime rank {rank}/{size}', component='worker')
 
     from distllm_tpu.parallel.fabric import FabricWorker
 
@@ -52,7 +54,7 @@ def main(argv: list[str] | None = None) -> int:
         heartbeat_interval=args.heartbeat_interval,
         idle_timeout=args.idle_timeout,
     )
-    print(f'[worker] connected to {args.coordinator}', flush=True)
+    log_event(f'[worker] connected to {args.coordinator}', component='worker')
     worker.run()
     return 0
 

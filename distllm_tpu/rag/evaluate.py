@@ -9,6 +9,7 @@ import argparse
 from pathlib import Path
 from typing import Any
 
+from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.rag.tasks import get_task
 from distllm_tpu.utils import BaseConfig
 
@@ -56,7 +57,10 @@ def run_eval_suite(config: EvalSuiteConfig) -> dict[str, dict[str, Any]]:
             task = get_task(task_name, config.download_dir)
             metrics = task.evaluate(generator)
             results.setdefault(f'model_{model_idx}', {})[task_name] = metrics
-            print(f'[eval] model_{model_idx} {task_name}: {metrics}')
+            log_event(
+                f'[eval] model_{model_idx} {task_name}: {metrics}',
+                component='eval',
+            )
     if config.output_path is not None:
         import json
 

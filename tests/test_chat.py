@@ -1,6 +1,7 @@
 """Chat CLI + OpenAI-compatible server tests."""
 
 import json
+import re
 import threading
 
 import pytest
@@ -141,7 +142,7 @@ def test_chat_server_endpoints(chat_server_client):
     import requests
 
     base = chat_server_client
-    assert requests.get(f'{base}/health').json() == {'status': 'ok'}
+    assert requests.get(f'{base}/health').json()['status'] == 'ok'
 
     r = requests.post(
         f'{base}/v1/chat/completions',
@@ -172,3 +173,72 @@ def test_chat_server_endpoints(chat_server_client):
     chunk = json.loads(lines[0][len(b'data: ') :])
     assert chunk['object'] == 'chat.completion.chunk'
     assert 'stream me' in chunk['choices'][0]['delta']['content']
+
+
+def test_chat_server_health_enriched(chat_server_client):
+    import requests
+
+    base = chat_server_client
+    requests.post(
+        f'{base}/v1/chat/completions',
+        json={'messages': [{'role': 'user', 'content': 'warm up'}]},
+    )
+    body = requests.get(f'{base}/health').json()
+    assert body['status'] == 'ok'
+    assert body['uptime_s'] >= 0
+    assert body['in_flight'] == 0  # this request is excluded from its own count
+    assert body['requests_served'] >= 1
+    assert isinstance(body['version'], str)
+
+
+def test_chat_server_metrics_exposition(chat_server_client):
+    import requests
+
+    base = chat_server_client
+    # Drive one request through so the latency histogram has observations.
+    requests.post(
+        f'{base}/v1/chat/completions',
+        json={'messages': [{'role': 'user', 'content': 'measure me'}]},
+    )
+    r = requests.get(f'{base}/metrics')
+    assert r.status_code == 200
+    assert r.headers['Content-Type'].startswith('text/plain')
+    text = r.text
+    # Acceptance criteria: engine throughput, KV occupancy, queue depth and
+    # the request-latency histogram must all be present in one scrape.
+    assert '# TYPE distllm_engine_generated_tokens_total counter' in text
+    assert '# TYPE distllm_kv_cache_occupancy_ratio gauge' in text
+    assert '# TYPE distllm_scheduler_queue_depth gauge' in text
+    assert (
+        '# TYPE distllm_http_request_duration_seconds histogram' in text
+    )
+    assert 'distllm_http_request_duration_seconds_bucket{path="/v1/chat/completions",le="+Inf"}' in text
+    assert 'distllm_http_request_duration_seconds_count{path="/v1/chat/completions"}' in text
+    # Every sample line parses as <name>{labels} <value>.
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+        r'(\+Inf|-Inf|NaN|[0-9.eE+-]+)$'
+    )
+    for line in text.strip().splitlines():
+        if line.startswith('#'):
+            assert line.startswith(('# HELP ', '# TYPE ')), line
+        else:
+            assert sample_re.match(line), line
+
+
+def test_chat_server_traces_endpoint(chat_server_client):
+    import requests
+
+    base = chat_server_client
+    requests.post(
+        f'{base}/v1/chat/completions',
+        json={'messages': [{'role': 'user', 'content': 'trace me'}]},
+    )
+    body = requests.get(f'{base}/debug/traces?limit=50').json()
+    assert 'spans' in body
+    names = [s['name'] for s in body['spans']]
+    assert 'chat-generate' in names
+    for span in body['spans']:
+        assert span['status'] in ('ok', 'error')
+        assert span['duration_s'] >= 0
+    assert requests.get(f'{base}/debug/traces?limit=x').status_code == 400

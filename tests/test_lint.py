@@ -92,6 +92,31 @@ def test_no_unused_imports():
     assert not offenders, 'unused imports:\n' + '\n'.join(offenders)
 
 
+def test_no_raw_print_telemetry():
+    """Telemetry goes through ``observability.log_event`` (counted, greppable),
+    not bare ``print(`` — which bypasses the metrics registry and is invisible
+    to scrapes. Only ``timer.py`` (the legacy ``[timer]`` line emitter) and
+    the ``observability`` package itself may print."""
+    package = REPO / 'distllm_tpu'
+    offenders = []
+    for path in sorted(package.rglob('*.py')):
+        relative = path.relative_to(package)
+        if relative.name == 'timer.py' or relative.parts[0] == 'observability':
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == 'print'
+            ):
+                offenders.append(f'{path.relative_to(REPO)}:{node.lineno}')
+    assert not offenders, (
+        'raw print( telemetry (use distllm_tpu.observability.log_event):\n'
+        + '\n'.join(offenders)
+    )
+
+
 @pytest.mark.skipif(shutil.which('ruff') is None, reason='ruff not installed')
 def test_ruff():
     proc = subprocess.run(

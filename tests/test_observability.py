@@ -1,0 +1,353 @@
+"""Metrics registry, tracing, Timer shim, aggregation, scheduler wiring
+(ISSUE 1 tentpole + satellites)."""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import pytest
+
+from distllm_tpu.observability import (
+    MetricsRegistry,
+    TraceBuffer,
+    get_registry,
+    get_trace_buffer,
+    log_buckets,
+    log_event,
+    span,
+)
+from distllm_tpu.observability.aggregate import (
+    aggregate_lines,
+    aggregate_logs,
+    format_stats_table,
+)
+from distllm_tpu.timer import TimeLogger, TimeStats, Timer
+
+
+# ------------------------------------------------------------------ metrics
+def test_counter_semantics():
+    registry = MetricsRegistry()
+    c = registry.counter('test_events_total', 'events')
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_counter_labels_are_independent():
+    registry = MetricsRegistry()
+    c = registry.counter('test_by_kind_total', labelnames=('kind',))
+    c.labels(kind='a').inc()
+    c.labels(kind='a').inc()
+    c.labels(kind='b').inc(5)
+    assert c.labels(kind='a').value == 2
+    assert c.labels(kind='b').value == 5
+    with pytest.raises(ValueError):
+        c.labels(wrong='x')
+    with pytest.raises(ValueError):
+        c.inc()  # labeled metric used without labels
+
+
+def test_registry_get_or_create_and_conflicts():
+    registry = MetricsRegistry()
+    a = registry.counter('test_total', 'help')
+    assert registry.counter('test_total') is a
+    with pytest.raises(ValueError):
+        registry.gauge('test_total')  # type conflict
+    with pytest.raises(ValueError):
+        registry.counter('test_total', labelnames=('x',))  # label conflict
+    with pytest.raises(ValueError):
+        registry.counter('bad name')
+
+
+def test_gauge_semantics():
+    registry = MetricsRegistry()
+    g = registry.gauge('test_depth')
+    g.set(10)
+    g.inc(3)
+    g.dec()
+    assert g.value == 12
+
+
+def test_histogram_semantics():
+    registry = MetricsRegistry()
+    h = registry.histogram('test_seconds', buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(56.05)
+    text = registry.render()  # bucket counts are cumulative
+    assert 'test_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_seconds_bucket{le="1"} 3' in text
+    assert 'test_seconds_bucket{le="10"} 4' in text
+    assert 'test_seconds_bucket{le="+Inf"} 5' in text
+    with pytest.raises(ValueError):
+        registry.histogram('test_bad', buckets=(1.0, 1.0))
+
+
+def test_log_buckets_ladder():
+    buckets = log_buckets(1e-3, 10.0, per_decade=1)
+    assert buckets == (0.001, 0.01, 0.1, 1.0, 10.0)
+    assert list(buckets) == sorted(buckets)
+    with pytest.raises(ValueError):
+        log_buckets(0, 1)
+
+
+def test_prometheus_exposition_format():
+    registry = MetricsRegistry()
+    c = registry.counter('app_requests_total', 'requests', ('path',))
+    c.labels(path='/x "quoted"\nline').inc()
+    registry.gauge('app_depth', 'depth').set(4)
+    h = registry.histogram('app_latency_seconds', 'latency', buckets=(1.0,))
+    h.observe(0.5)
+    text = registry.render()
+    assert '# HELP app_requests_total requests' in text
+    assert '# TYPE app_requests_total counter' in text
+    # Label values escape backslash/quote/newline.
+    assert 'app_requests_total{path="/x \\"quoted\\"\\nline"} 1' in text
+    assert 'app_depth 4' in text
+    assert 'app_latency_seconds_bucket{le="1"} 1' in text
+    assert 'app_latency_seconds_bucket{le="+Inf"} 1' in text
+    assert 'app_latency_seconds_sum 0.5' in text
+    assert 'app_latency_seconds_count 1' in text
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? (\+Inf|-Inf|[0-9.eE+-]+)$'
+    )
+    for line in text.strip().splitlines():
+        if not line.startswith('#'):
+            assert sample_re.match(line), line
+
+
+# ------------------------------------------------------------------ tracing
+def test_span_nesting_and_status():
+    buffer = TraceBuffer()
+    with span('outer', buffer=buffer) as outer:
+        with span('inner', 'tag-1', buffer=buffer) as inner:
+            assert inner.parent_id == outer.span_id
+    spans = buffer.snapshot()
+    assert [s.name for s in spans] == ['inner', 'outer']  # close order
+    assert all(s.status == 'ok' for s in spans)
+    assert spans[0].duration_s >= 0
+
+    with pytest.raises(RuntimeError, match='boom'):
+        with span('failing', buffer=buffer):
+            raise RuntimeError('boom')
+    failed = buffer.snapshot()[-1]
+    assert failed.status == 'error'
+    assert 'boom' in failed.error
+
+
+def test_trace_ring_eviction_and_dump(tmp_path):
+    buffer = TraceBuffer(capacity=3)
+    for i in range(5):
+        with span(f's{i}', buffer=buffer):
+            pass
+    assert len(buffer) == 3
+    assert buffer.total_recorded == 5
+    assert [s.name for s in buffer.snapshot()] == ['s2', 's3', 's4']
+    assert [s.name for s in buffer.snapshot(limit=2)] == ['s3', 's4']
+
+    out = tmp_path / 'traces.jsonl'
+    assert buffer.dump_jsonl(out) == 3
+    records = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r['name'] for r in records] == ['s2', 's3', 's4']
+    assert all(r['status'] == 'ok' for r in records)
+    assert all(r['duration_s'] is not None for r in records)
+
+
+# --------------------------------------------------------------- Timer shim
+def test_timer_emits_legacy_line_and_span(capsys):
+    buffer = get_trace_buffer()
+    before = buffer.total_recorded
+    with Timer('shim-stage', 'file-9'):
+        pass
+    out = capsys.readouterr().out
+    stats = TimeLogger().parse_lines(out)  # legacy format still parses
+    assert stats[('shim-stage', 'file-9')].count == 1
+    assert buffer.total_recorded == before + 1
+    recorded = buffer.snapshot()[-1]
+    assert recorded.name == 'shim-stage'
+    assert recorded.tags == ('shim-stage', 'file-9')
+    assert recorded.status == 'ok'
+
+
+def test_timer_tags_error_spans(capsys):
+    buffer = get_trace_buffer()
+    with pytest.raises(ValueError):
+        with Timer('doomed-stage'):
+            raise ValueError('nope')
+    # Legacy line still emitted for failed work (scrapers expect it)...
+    assert '[timer] tags=doomed-stage' in capsys.readouterr().out
+    # ...but the span distinguishes the outcome.
+    recorded = buffer.snapshot()[-1]
+    assert recorded.status == 'error'
+    assert 'nope' in recorded.error
+
+
+def test_timer_observes_stage_histogram():
+    h = get_registry().get('distllm_stage_duration_seconds')
+    child = h.labels(stage='histo-stage', status='ok')
+    before = child.count
+    with Timer('histo-stage', echo=False):
+        pass
+    assert child.count == before + 1
+
+
+def test_timer_restart_without_stop_does_not_leak_stack():
+    from distllm_tpu.observability import tracing
+
+    t = Timer('restarted', echo=False)
+    t.start()
+    t.start()  # restart with no stop(): the stale span must be abandoned
+    t.stop()
+    assert tracing._stack() == []
+    with span('after-restart') as s:
+        assert s.parent_id is None
+
+
+def test_timer_never_started_raises():
+    t = Timer('idle')
+    with pytest.raises(RuntimeError):
+        t.elapsed_s
+    with pytest.raises(RuntimeError):
+        t.stop()
+
+
+def test_timestats_percentiles():
+    stats = TimeStats(tags=('x',), elapsed_s=[4.0, 1.0, 3.0, 2.0])
+    assert stats.p50_s == 2.0
+    assert stats.p95_s == 4.0
+    assert stats.max_s == 4.0
+    empty = TimeStats(tags=('y',))
+    assert empty.p50_s == 0.0 and empty.p95_s == 0.0 and empty.max_s == 0.0
+    single = TimeStats(tags=('z',), elapsed_s=[7.0])
+    assert single.p50_s == single.p95_s == single.max_s == 7.0
+
+
+# -------------------------------------------------------------- aggregation
+def _fake_log(tag: str, values: list[float]) -> str:
+    return '\n'.join(
+        f'[timer] tags={tag} elapsed_s={v:.9f} start_ns=0 end_ns=1'
+        for v in values
+    )
+
+
+def test_aggregate_multi_host_logs(tmp_path):
+    log_a = tmp_path / 'host-a.log'
+    log_b = tmp_path / 'host-b.log'
+    log_a.write_text(_fake_log('embed,f1', [1.0, 2.0]))
+    log_b.write_text(_fake_log('embed,f1', [3.0]) + '\n' + _fake_log('write', [0.5]))
+    merged = aggregate_logs([log_a, log_b])
+    assert merged[('embed', 'f1')].count == 3
+    assert merged[('embed', 'f1')].total_s == pytest.approx(6.0)
+    assert merged[('write',)].count == 1
+
+    table = format_stats_table(merged)
+    lines = table.splitlines()
+    assert lines[0].split()[:2] == ['tags', 'count']
+    assert 'p50_s' in lines[0] and 'p95_s' in lines[0] and 'max_s' in lines[0]
+    assert lines[2].startswith('embed,f1')  # sorted by total desc
+
+    assert aggregate_lines([]) == {}
+
+
+# ---------------------------------------------------------------- log_event
+def test_log_event_prints_and_counts(capsys):
+    counter = get_registry().get('distllm_log_messages_total')
+    child = counter.labels(component='test-comp')
+    before = child.value
+    log_event('[test] hello', component='test-comp')
+    assert capsys.readouterr().out == '[test] hello\n'
+    assert child.value == before + 1
+
+
+# --------------------------------------------------- scheduler instrumentation
+def test_instrumented_scheduler_publishes_metrics():
+    from distllm_tpu.generate.engine.scheduler import (
+        InstrumentedScheduler,
+        PyScheduler,
+    )
+    from distllm_tpu.observability import instruments
+
+    sched = InstrumentedScheduler(
+        PyScheduler(num_blocks=9, block_size=4, max_num_seqs=2),
+        num_blocks=9,
+    )
+    assert instruments.KV_BLOCKS_TOTAL.value == 8
+    admitted_before = instruments.SCHED_ADMITTED.value
+    deferred_before = instruments.SCHED_DEFERRED.value
+
+    sched.add(0, 4)
+    sched.add(1, 4)
+    sched.add(2, 4)
+    assert instruments.SCHED_QUEUE_DEPTH.value == 3
+    assert sched.admit_next() == 0
+    assert sched.admit_next() == 1
+    assert sched.admit_next() is None  # no free slot -> deferred
+    assert instruments.SCHED_ADMITTED.value == admitted_before + 2
+    assert instruments.SCHED_DEFERRED.value == deferred_before + 1
+    assert instruments.SCHED_RUNNING.value == 2
+    assert instruments.SCHED_QUEUE_DEPTH.value == 1
+    assert instruments.KV_BLOCKS_IN_USE.value == 4  # 2 blocks per request
+    assert instruments.KV_OCCUPANCY.value == pytest.approx(0.5)
+
+    sched.finish(0)
+    sched.finish(1)
+    sched.finish(2)
+    assert instruments.SCHED_RUNNING.value == 0
+    assert instruments.KV_BLOCKS_IN_USE.value == 0
+
+
+def test_instrumented_scheduler_counts_preemptions():
+    from distllm_tpu.generate.engine.scheduler import (
+        InstrumentedScheduler,
+        PyScheduler,
+    )
+    from distllm_tpu.observability import instruments
+
+    sched = InstrumentedScheduler(
+        PyScheduler(num_blocks=5, block_size=2, max_num_seqs=2),
+        num_blocks=5,
+    )
+    preempt_before = instruments.SCHED_PREEMPTIONS.value
+    sched.add(0, 2)
+    sched.add(1, 2)
+    assert sched.admit_next() == 0
+    assert sched.admit_next() == 1
+    # Grow both sequences until the pool runs dry -> youngest preempted.
+    for _ in range(4):
+        sched.append_token(0)
+        sched.append_token(1)
+    preempted = sched.prepare_decode(2)
+    assert preempted == [1]
+    assert instruments.SCHED_PREEMPTIONS.value == preempt_before + 1
+
+
+# ------------------------------------------------------- known-series catalog
+def test_instruments_catalog_renders_engine_series():
+    """The full serving schema is present in a scrape before any traffic."""
+    from distllm_tpu.observability import render_prometheus
+
+    text = render_prometheus()
+    for name in (
+        'distllm_engine_generated_tokens_total',
+        'distllm_engine_prefill_dispatches_total',
+        'distllm_engine_decode_windows_total',
+        'distllm_kv_cache_blocks_total',
+        'distllm_kv_cache_occupancy_ratio',
+        'distllm_scheduler_queue_depth',
+        'distllm_scheduler_preemptions_total',
+        'distllm_http_requests_in_flight',
+    ):
+        assert f'# TYPE {name} ' in text, name
+
+
+def test_histogram_inf_bucket_formatting():
+    registry = MetricsRegistry()
+    h = registry.histogram('edge_seconds', buckets=(1.0,))
+    h.observe(math.inf)  # lands in +Inf bucket without error
+    assert h.count == 1
+    assert 'edge_seconds_bucket{le="+Inf"} 1' in registry.render()
