@@ -6,6 +6,13 @@ manager that prints one machine-parseable line per timed span to stdout, and a
 every pipeline stage with these, and the lines are the primary telemetry
 channel across the process/node boundary (they survive in scheduler logs).
 
+``Timer`` is now a shim over :mod:`distllm_tpu.observability`: each stop
+emits BOTH the legacy ``[timer]`` line below (so ``TimeLogger.parse_logs``
+and every existing log-scraping tool keep working) and a
+:class:`~distllm_tpu.observability.tracing.Span` into the process trace
+ring, tagged ``ok``/``error`` by how the timed block exited, plus a
+``distllm_stage_duration_seconds`` histogram observation.
+
 Line format (one line per completed span)::
 
     [timer] tags=load-encoder,file-3 elapsed_s=1.234567890 start_ns=... end_ns=...
@@ -13,10 +20,13 @@ Line format (one line per completed span)::
 
 from __future__ import annotations
 
+import math
 import re
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
+
+from distllm_tpu.observability import instruments, tracing
 
 _LINE_RE = re.compile(
     r'\[timer\] tags=(?P<tags>\S*) '
@@ -46,6 +56,26 @@ class TimeStats:
     def count(self) -> int:
         return len(self.elapsed_s)
 
+    def _percentile(self, q: float) -> float:
+        """Nearest-rank percentile (0.0 on empty stats, like ``mean_s``)."""
+        if not self.elapsed_s:
+            return 0.0
+        ordered = sorted(self.elapsed_s)
+        rank = max(1, math.ceil(q * len(ordered)))
+        return ordered[rank - 1]
+
+    @property
+    def p50_s(self) -> float:
+        return self._percentile(0.50)
+
+    @property
+    def p95_s(self) -> float:
+        return self._percentile(0.95)
+
+    @property
+    def max_s(self) -> float:
+        return max(self.elapsed_s) if self.elapsed_s else 0.0
+
 
 class Timer:
     """Context manager that times a span and prints a parseable line.
@@ -59,23 +89,42 @@ class Timer:
         self.echo = echo
         self.start_ns: int | None = None
         self.end_ns: int | None = None
+        self.status: str | None = None
+        self._span: tracing.Span | None = None
 
     @property
     def elapsed_s(self) -> float:
         if self.start_ns is None:
-            return 0.0
+            raise RuntimeError(
+                'Timer.elapsed_s read before start() — a never-started '
+                'timer has no elapsed time'
+            )
         end = self.end_ns if self.end_ns is not None else time.monotonic_ns()
         return (end - self.start_ns) / 1e9
 
     def start(self) -> 'Timer':
-        self.start_ns = time.monotonic_ns()
+        if self._span is not None:  # restart without stop(): drop stale span
+            tracing.abandon_span(self._span)
+        self._span = tracing.begin_span(
+            self.tags[0] if self.tags else 'timer', *self.tags
+        )
+        self.start_ns = self._span.start_ns
         self.end_ns = None
+        self.status = None
         return self
 
-    def stop(self) -> float:
-        if self.start_ns is None:
+    def stop(self, status: str | None = None,
+             error: BaseException | None = None) -> float:
+        if self.start_ns is None or self._span is None:
             raise RuntimeError('Timer.stop() called before start()')
-        self.end_ns = time.monotonic_ns()
+        self.status = status or 'ok'
+        finished = tracing.end_span(self._span, status=self.status, error=error)
+        self.end_ns = finished.end_ns
+        self._span = None
+        instruments.STAGE_SECONDS.labels(
+            stage=self.tags[0] if self.tags else 'untagged',
+            status=self.status,
+        ).observe(self.elapsed_s)
         if self.echo:
             print(self.log_line(), flush=True)
         return self.elapsed_s
@@ -90,14 +139,21 @@ class Timer:
     def __enter__(self) -> 'Timer':
         return self.start()
 
-    def __exit__(self, *exc) -> None:
-        self.stop()
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # The legacy line is printed either way (log scrapers expect every
+        # span); only the span record distinguishes failed work.
+        self.stop(
+            status='error' if exc_type is not None else 'ok',
+            error=exc if isinstance(exc, BaseException) else None,
+        )
 
 
 class TimeLogger:
     """Parse ``[timer]`` lines from captured stdout/log files back to stats.
 
     Parity with ``TimeLogger.parse_logs`` (``distllm/timer.py:129-154``).
+    Multi-file/multi-host rollups live in
+    ``distllm_tpu.observability.aggregate``.
     """
 
     def parse_lines(self, lines: list[str] | str) -> dict[tuple[str, ...], TimeStats]:
