@@ -219,11 +219,7 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
     import jax
     import numpy as np
 
-    from distllm_tpu.generate.engine.engine import (
-        EngineConfig,
-        LLMEngine,
-        SamplingParams,
-    )
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
     from distllm_tpu.models import mistral
 
     small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
@@ -243,9 +239,6 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
             )
         )
     )
-
-    class _Tok:
-        eos_id = None
 
     if quantization is None:
         # bf16: 13.5 GiB weights + 32 seqs x 22 blocks x 2 MiB = 1.4 GiB KV.
@@ -282,15 +275,7 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
     # repeat runs start hot. jax.jit is lazy, so an unavailable Pallas
     # lowering only surfaces here — probe via warmup and fall back to XLA,
     # recording WHY the preferred backend was rejected.
-    backends = ['xla'] if jax.default_backend() == 'cpu' else ['pallas', 'xla']
-    engine = None
-    fallback_reason = None
-    cache_before = _cache_entries()
-    warmup_start = time.perf_counter()
-    for backend in backends:
-        engine_cfg.attn_backend = backend
-        # Fresh params per candidate: the engine owns (and may delete)
-        # them for destructive HBM optimizations (relayout, quant cleanup).
+    def make_params():
         if quantization is not None and jax.default_backend() != 'cpu':
             # Quantize on the HOST cpu device and ship only the codes:
             # letting the engine quantize device-resident bf16 streams
@@ -323,32 +308,18 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
                 mode=quantization,
                 out_dtype=model_cfg.dtype,
             )
-            params = jax.device_put(qtree, jax.devices()[0])
-        else:
-            params = mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
-        candidate = LLMEngine(
-            model_cfg, params, _Tok(), engine_cfg, own_params=True
-        )
-        try:
-            candidate.warmup()
-            candidate.generate_ids(
-                prompts[:2],
-                SamplingParams(
-                    temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=4
-                ),
-            )
-            engine = candidate
-            break
-        except Exception as exc:
-            # Free the failed engine's KV cache before building the
-            # fallback: two live caches beside 7B weights would OOM HBM.
-            if backend != backends[-1]:
-                fallback_reason = f'{backend}: {exc!r}'[:400]
-            candidate.shutdown()
-            del params
-            if backend == backends[-1]:
-                raise
-    assert engine is not None
+            return jax.device_put(qtree, jax.devices()[0])
+        return mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
+
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
+    engine, fallback_reason = _build_engine_with_fallback(
+        model_cfg,
+        engine_cfg,
+        make_params,
+        prompts[:2],
+        SamplingParams(temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=4),
+    )
     warmup_secs = time.perf_counter() - warmup_start
 
     # Time-to-first-token on the WARMED engine: one prompt, one token —
@@ -422,6 +393,151 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
     }
     if quantization:
         out[f'{prefix}quantization'] = quantization
+    if fallback_reason:
+        out[f'{prefix}attn_fallback_reason'] = fallback_reason
+    for key, val in engine.telemetry.items():
+        out[f'{prefix}{key}'] = val
+    return out
+
+
+def _build_engine_with_fallback(
+    model_cfg, engine_cfg, make_params, smoke_prompts, smoke_params
+):
+    """Build the serving engine, probing attn backends in preference order
+    (Pallas first on TPU). jax.jit is lazy, so an unavailable Pallas
+    lowering only surfaces at warmup — each candidate is warmed and
+    smoke-run before being accepted, and a failed candidate's KV cache is
+    freed BEFORE the fallback is built (two live caches beside 7B weights
+    would OOM HBM). Returns ``(engine, fallback_reason)``; raises when the
+    last backend fails too. One home for this ladder so the gen stages
+    cannot drift on the teardown ordering.
+    """
+    import jax
+
+    from distllm_tpu.generate.engine.engine import LLMEngine
+
+    class _Tok:
+        eos_id = None
+
+    backends = ['xla'] if jax.default_backend() == 'cpu' else ['pallas', 'xla']
+    fallback_reason = None
+    for backend in backends:
+        engine_cfg.attn_backend = backend
+        # Fresh params per candidate: the engine owns (and may delete)
+        # them for destructive HBM optimizations (relayout, quant cleanup).
+        params = make_params()
+        candidate = LLMEngine(
+            model_cfg, params, _Tok(), engine_cfg, own_params=True
+        )
+        try:
+            candidate.warmup()
+            candidate.generate_ids(smoke_prompts, smoke_params)
+            return candidate, fallback_reason
+        except Exception as exc:
+            if backend != backends[-1]:
+                fallback_reason = f'{backend}: {exc!r}'[:400]
+            candidate.shutdown()
+            del params
+            if backend == backends[-1]:
+                raise
+    raise AssertionError('unreachable')
+
+
+def _stage_gen_prefix() -> dict:
+    """Prefix-caching serving stage (docs/prefix_caching.md): repeated
+    shared-prefix prompts — the RAG-chat / MCQA shape where every request
+    repeats a long system-prompt/stem and differs only in a short tail.
+
+    Records ``gen_prefix_ttft_s`` (warm TTFT with the prefix cached — the
+    number prefix caching exists to shrink), the cold TTFT baseline on the
+    SAME engine, cache hit rate, and throughput over the full workload.
+    """
+    import jax
+    import numpy as np
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.models import mistral
+
+    prefix = 'gen_prefix_'
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+        )
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+
+    engine_cfg = EngineConfig(
+        block_size=16,
+        num_blocks=712,
+        max_num_seqs=32,
+        max_model_len=512,
+        decode_steps=16,
+        pipeline_depth=2,
+        sampling_top_window=64,
+        enable_prefix_cache=True,
+        prefill_chunk_tokens=256,
+    )
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
+    engine, fallback_reason = _build_engine_with_fallback(
+        model_cfg,
+        engine_cfg,
+        lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+        [[1, 2, 3]],
+        SamplingParams(temperature=0.0, max_tokens=2),
+    )
+    warmup_secs = time.perf_counter() - warmup_start
+
+    # Workload: one 320-token shared prefix (20 blocks), 32 requests with
+    # distinct 16-token tails — the round-5 RAG serving shape.
+    rng = np.random.default_rng(0)
+    shared = list(rng.integers(1, model_cfg.vocab_size, size=320))
+    prompts = [
+        shared + list(rng.integers(1, model_cfg.vocab_size, size=16))
+        for _ in range(32)
+    ]
+    one_token = SamplingParams(
+        temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=1
+    )
+    # Cold TTFT: nothing cached, full 336-token prefill.
+    t0 = time.perf_counter()
+    engine.generate_ids(prompts[:1], one_token)
+    ttft_cold_s = time.perf_counter() - t0
+    # Warm TTFT: the 320-token prefix is cached; prefill covers the tail.
+    t0 = time.perf_counter()
+    engine.generate_ids(prompts[1:2], one_token)
+    ttft_warm_s = time.perf_counter() - t0
+
+    sampling = SamplingParams(
+        temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=64
+    )
+    start = time.perf_counter()
+    outs = engine.generate_ids(prompts[2:], sampling)
+    elapsed = time.perf_counter() - start
+    n_tokens = sum(len(o) for o in outs)
+    out = {
+        f'{prefix}metric': 'warm shared-prefix TTFT',
+        f'{prefix}ttft_s': round(ttft_warm_s, 3),
+        f'{prefix}ttft_cold_s': round(ttft_cold_s, 3),
+        f'{prefix}ttft_speedup': round(ttft_cold_s / max(ttft_warm_s, 1e-9), 2),
+        f'{prefix}throughput_tok_s': round(n_tokens / elapsed, 2),
+        f'{prefix}n_tokens': n_tokens,
+        f'{prefix}attn_backend': engine.config.attn_backend,
+        f'{prefix}shared_prefix_tokens': len(shared),
+        f'{prefix}warmup_secs': round(warmup_secs, 1),
+        f'{prefix}workload': _workload_fingerprint(
+            {'prompts': [list(map(int, p)) for p in prompts],
+             'sampling': sampling.__dict__,
+             'engine': {'block_size': engine_cfg.block_size,
+                        'num_blocks': engine_cfg.num_blocks,
+                        'max_num_seqs': engine_cfg.max_num_seqs,
+                        'prefill_chunk_tokens':
+                            engine_cfg.prefill_chunk_tokens}}
+        ),
+        **_cache_fields(prefix, cache_before),
+    }
     if fallback_reason:
         out[f'{prefix}attn_fallback_reason'] = fallback_reason
     for key, val in engine.telemetry.items():
@@ -520,7 +636,7 @@ def _run_stage(stage: str, timeout: int) -> dict:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        '--stage', choices=['embed', 'embed_q', 'gen', 'gen_q']
+        '--stage', choices=['embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix']
     )
     args = parser.parse_args()
 
@@ -556,6 +672,9 @@ def main() -> None:
     if args.stage == 'gen_q':
         print(json.dumps(_stage_gen_q()))
         return
+    if args.stage == 'gen_prefix':
+        print(json.dumps(_stage_gen_prefix()))
+        return
 
     result: dict = {
         'metric': 'embeddings/sec/chip',
@@ -573,6 +692,7 @@ def main() -> None:
     result.update(_run_stage('embed_q', timeout=1200))
     result.update(_run_stage('gen', timeout=2700))
     result.update(_run_stage('gen_q', timeout=2700))
+    result.update(_run_stage('gen_prefix', timeout=2700))
     print(json.dumps(result))
 
 

@@ -106,6 +106,13 @@ class ChatAppConfig(BaseConfig):
         name = backend.pop('name', 'fake')
         if name == 'http':
             return make_http_generator(**backend)
+        if name in ('tpu', 'vllm'):
+            # Chat workloads are prefix-heavy by construction: the system
+            # prompt and retrieved contexts lead every rendered prompt and
+            # repeat across turns, so the engine's automatic prefix cache
+            # (docs/prefix_caching.md) is on unless the config says
+            # otherwise.
+            backend.setdefault('enable_prefix_cache', True)
         from distllm_tpu.generate import get_generator
 
         return get_generator({'name': name, **backend}, register=True)

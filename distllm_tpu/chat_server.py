@@ -8,6 +8,12 @@ single-delta SSE streaming; request extensions ``top_k`` and
 permissive CORS. FastAPI is unavailable in this environment, so the server
 is aiohttp.
 
+Serving note: prompts render as system prompt + retrieved contexts +
+conversation — a shared, growing prefix across a session's turns — so the
+in-process TPU engine runs with automatic prefix caching on by default
+(``ChatAppConfig.build_generator``; knobs/metrics in
+docs/prefix_caching.md, ``distllm_prefix_cache_*`` series at /metrics).
+
 Observability surface (docs/observability.md):
 
 - ``GET /metrics`` — Prometheus text exposition of the process registry

@@ -38,7 +38,11 @@ import jax.numpy as jnp
 import numpy as np
 from pydantic import field_validator
 
-from distllm_tpu.generate.engine.kv_cache import PagedKVCache
+from distllm_tpu.generate.engine.kv_cache import (
+    PagedKVCache,
+    PrefixCache,
+    block_digests,
+)
 from distllm_tpu.generate.engine.scheduler import (
     InstrumentedScheduler,
     SchedulerExhausted,
@@ -80,6 +84,19 @@ class Request:
     params: SamplingParams
     state: RequestState = RequestState.WAITING
     output_ids: list[int] = field(default_factory=list)
+    # --- automatic prefix caching (docs/prefix_caching.md) ---
+    # Chained block digests of the prompt's full blocks (cache keys).
+    digests: list[bytes] = field(default_factory=list)
+    # Prompt tokens whose KV is already valid in cache blocks at prefill
+    # time — prefill runs only on the tail past this point.
+    num_cached_tokens: int = 0
+    # Leading blocks of this request's row owned by the prefix cache
+    # (mirrors the scheduler's borrowed-prefix count).
+    num_borrowed_blocks: int = 0
+    # Aligned full-cover hit: the final matched block is SHARED and the
+    # last prompt token must be recomputed into a private copy of it
+    # (copy-on-write, resolved at prefill dispatch).
+    cow_src_block: int | None = None
 
     @property
     def num_tokens(self) -> int:
@@ -132,12 +149,25 @@ class EngineConfig(BaseConfig):
     # either way.
     decode_layer_unroll: bool = True
 
-    @field_validator('sampling_top_window')
+    @field_validator('sampling_top_window', 'prefill_chunk_tokens')
     @classmethod
-    def _non_negative_window(cls, v: int) -> int:
+    def _non_negative_window(cls, v: int, info) -> int:
         if v < 0:
-            raise ValueError('sampling_top_window must be >= 0')
+            raise ValueError(f'{info.field_name} must be >= 0')
         return v
+    # Automatic prefix caching (docs/prefix_caching.md): full prompt
+    # blocks enter a hash-chain cache as they prefill; later requests
+    # sharing a block-aligned prefix reuse those KV blocks (refcounted,
+    # LRU-evicted under pool pressure) and prefill ONLY the uncached tail
+    # — TTFT and prefill compute drop from O(prompt) to O(tail) for
+    # prefix-heavy workloads (RAG system prompts, MCQA stems).
+    enable_prefix_cache: bool = False
+    # Split uncached prefill tails longer than this many tokens into
+    # bucketed chunks dispatched sequentially (each chunk attends to the
+    # KV already in the paged cache), so one long prompt cannot
+    # monopolize the chip in a single monolithic dispatch. 0 disables
+    # chunking.
+    prefill_chunk_tokens: int = 0
     # Decode windows in flight during generate_ids (2 hides the
     # host<->device round trip behind the next window's compute).
     pipeline_depth: int = 2
@@ -265,19 +295,31 @@ class LLMEngine:
                 out_dtype=model.dtype,
                 delete_source=self._own_params,
             )
-            if mesh is not None:
+            # Resolve the quantized-matmul tier ONCE, here, and pin it
+            # into the model config the jitted forwards close over.
+            # dense() otherwise re-reads the process-global
+            # default_backend() at trace time, so a set_default_backend
+            # call between engine construction and the first dispatch
+            # could route a 'pallas' kernel under a TP mesh — past the
+            # mesh check below (the TP-mesh/pallas bypass, ADVICE r5).
+            from distllm_tpu.ops import quantized_matmul as _qmm
+
+            resolved_qmm = (
+                getattr(model, 'qmm_backend', None) or _qmm.default_backend()
+            )
+            if mesh is not None and resolved_qmm in ('pallas', 'interpret'):
                 # GSPMD cannot partition a pallas_call over model-sharded
                 # int8 kernels; the XLA scale-after-dot tier partitions
                 # like any dot. 'auto' already means 'xla', so only an
-                # explicit process-wide 'pallas' pin needs rejecting.
-                from distllm_tpu.ops import quantized_matmul as _qmm
-
-                if _qmm.default_backend() in ('pallas', 'interpret'):
-                    raise ValueError(
-                        'quantized-matmul backend '
-                        f'{_qmm.default_backend()!r} cannot serve under a '
-                        "tensor-parallel mesh; use 'auto'/'xla'"
-                    )
+                # explicit 'pallas' pin needs rejecting.
+                raise ValueError(
+                    'quantized-matmul backend '
+                    f'{resolved_qmm!r} cannot serve under a '
+                    "tensor-parallel mesh; use 'auto'/'xla'"
+                )
+            if hasattr(model, 'model_copy'):
+                model = model.model_copy(update={'qmm_backend': resolved_qmm})
+                self.model_cfg = model
 
         def prefill_fn(params, ids, mask, last_pos):
             hidden, k, v = mistral.prefill(params, model, ids, mask)
@@ -289,6 +331,32 @@ class LLMEngine:
             return mistral.logits(params, model, last_hidden)[:, 0], k, v
 
         self._prefill = jax.jit(prefill_fn)
+
+        # Automatic prefix caching: hash-chain over full prompt blocks,
+        # refcounted sharing, LRU eviction (docs/prefix_caching.md).
+        # Cache-hit tails and chunked prefills dispatch through
+        # prefill_paged (write tail K/V, attend over the paged cache).
+        self.prefix_cache = (
+            PrefixCache(cfg.block_size) if cfg.enable_prefix_cache else None
+        )
+        _max_tables = cfg.max_model_len
+
+        def prefill_paged_fn(params, ids, pos, k, v, bt, ctx, tails):
+            return mistral.prefill_paged(
+                params, model, ids, pos, k, v, bt, ctx, tails,
+                max_table_positions=_max_tables,
+            )
+
+        self._prefill_paged = jax.jit(prefill_paged_fn, donate_argnums=(3, 4))
+        # Batched COW: copy shared blocks' K/V (all layers) into the
+        # requests' private copies in one dispatch.
+        self._cow_copy = jax.jit(
+            lambda k, v, src, dst: (
+                k.at[:, dst].set(k[:, src]),
+                v.at[:, dst].set(v[:, src]),
+            ),
+            donate_argnums=(0, 1),
+        )
 
         attn_backend = cfg.attn_backend
         num_steps = cfg.decode_steps
@@ -310,6 +378,8 @@ class LLMEngine:
         # Resolved-at-serve-time values: a config that believes it enabled
         # the Pallas kernel can otherwise ship 3x slower with no signal.
         self.telemetry: dict[str, str] = {'attn_backend': attn_backend}
+        if cfg.quantization and hasattr(model, 'qmm_backend'):
+            self.telemetry['qmm_backend'] = model.qmm_backend
         if (
             self._own_params
             and mesh is None
@@ -351,6 +421,9 @@ class LLMEngine:
         # Tokens dispatched on device but not yet fetched, per request —
         # the pipelined path's lag bookkeeping.
         self._unacked: dict[int, int] = {}
+        # Set by _run_to_completion: lets chunked prefill retire one
+        # in-flight decode window between chunks.
+        self._drain_hook = None
         # Device-side last-token vector carried across the pipelined loop;
         # deferred prefill scatters freshly sampled first tokens into it.
         self._carried = None
@@ -546,9 +619,52 @@ class LLMEngine:
                     self._put(lengths),
                 )
                 np.asarray(self._sample_device(logits, [None] * b))
+                if (
+                    self.prefix_cache is not None
+                    or self.config.prefill_chunk_tokens
+                ):
+                    # Paged-context prefill shapes (cache-hit tails and
+                    # chunks dispatch through prefill_paged): tail_lens 0
+                    # routes every write to the trash block.
+                    (
+                        ids_dev,
+                        pos_dev,
+                        rows_dev,
+                        ctx_dev,
+                        tails_dev,
+                    ) = self._put_many(
+                        ids,
+                        np.zeros((b, bucket), np.int32),
+                        block_rows,
+                        np.ones((b,), np.int32),
+                        np.zeros((b,), np.int32),
+                    )
+                    pg_logits, self.kv.k, self.kv.v = self._prefill_paged(
+                        self.params,
+                        ids_dev,
+                        pos_dev,
+                        self.kv.k,
+                        self.kv.v,
+                        rows_dev,
+                        ctx_dev,
+                        tails_dev,
+                    )
+                    np.asarray(self._sample_device(pg_logits, [None] * b))
                 if b >= cap:
                     break
                 b *= 2
+        if self.prefix_cache is not None:
+            # Warm the COW block copy at its common shape (one hit per
+            # dispatch): src = dst = trash block 0 is a state-safe
+            # self-copy. Without this, the first aligned full-cover cache
+            # hit pays the compile inside the very TTFT the cache exists
+            # to shrink.
+            src_dev, dst_dev = self._put_many(
+                np.zeros((1,), np.int32), np.zeros((1,), np.int32)
+            )
+            self.kv.k, self.kv.v = self._cow_copy(
+                self.kv.k, self.kv.v, src_dev, dst_dev
+            )
         bsz = self.config.max_num_seqs
         # Warm the fused decode window: steps_left = 0 freezes every slot,
         # so all KV writes land in the trash block and no state advances.
@@ -595,8 +711,33 @@ class LLMEngine:
             prompt_ids=list(prompt_ids),
             params=params or SamplingParams(),
         )
+        cached_blocks: list[int] = []
+        if self.prefix_cache is not None:
+            bs = self.config.block_size
+            request.digests = block_digests(request.prompt_ids, bs)
+            matched = self.prefix_cache.acquire(
+                request.request_id, request.digests
+            )
+            if matched and len(matched) * bs == len(prompt_ids):
+                # Aligned full-cover hit: every prompt block is cached,
+                # but prefill must still produce last-token logits and
+                # the last token's K write would land INSIDE the shared
+                # final block. Keep the match, re-prefill only the last
+                # token, and copy-on-write that block at dispatch.
+                request.cow_src_block = matched[-1]
+                cached_blocks = matched[:-1]
+                request.num_cached_tokens = len(prompt_ids) - 1
+            else:
+                cached_blocks = matched
+                request.num_cached_tokens = len(matched) * bs
+            request.num_borrowed_blocks = len(cached_blocks)
+            _metrics.PREFIX_LOOKUP_TOKENS.inc(len(prompt_ids))
+            if request.num_cached_tokens:
+                _metrics.PREFIX_HIT_TOKENS.inc(request.num_cached_tokens)
+                self._stats['prefix_hit_tokens'] += request.num_cached_tokens
+            self._stats['prefix_lookup_tokens'] += len(prompt_ids)
         self._requests[request.request_id] = request
-        self.sched.add(request.request_id, request.num_tokens)
+        self.sched.add(request.request_id, request.num_tokens, cached_blocks)
         _metrics.ENGINE_REQUESTS_ADDED.inc()
         _metrics.ENGINE_PROMPT_TOKENS.inc(len(prompt_ids))
         return request.request_id
@@ -622,18 +763,24 @@ class LLMEngine:
         emitted: list[tuple[int, int]] = []
         while True:
             admitted: list[Request] = []
-            while (rid := self.sched.admit_next()) is not None:
+            while (rid := self._admit_next_evicting()) is not None:
                 request = self._requests[rid]
                 request.state = RequestState.RUNNING
                 admitted.append(request)
             if not admitted:
                 return emitted
             groups: dict[int, list[Request]] = {}
+            paged: list[Request] = []
+            chunk = self.config.prefill_chunk_tokens
             for request in admitted:
                 # Re-prefill covers generated tokens too (recompute
-                # preemption path).
-                length = request.num_tokens
-                bucket = pick_bucket(length, self.prefill_buckets)
+                # preemption path) but never the cached prefix — tail-only
+                # prefill is the prefix cache's whole win.
+                tail = request.num_tokens - request.num_cached_tokens
+                if request.num_cached_tokens or (chunk and tail > chunk):
+                    paged.append(request)
+                    continue
+                bucket = pick_bucket(tail, self.prefill_buckets)
                 groups.setdefault(bucket, []).append(request)
             for bucket, requests in sorted(groups.items()):
                 cap = self._prefill_batch_cap(bucket)
@@ -645,6 +792,58 @@ class LLMEngine:
                             requests[i : i + cap], bucket, defer_to
                         )
                     )
+            emitted.extend(self._run_prefill_paged(paged, defer_to))
+
+    def _admit_next_evicting(self) -> int | None:
+        """``admit_next`` with prefix-cache eviction pressure: when
+        admission stalls on blocks while unreferenced cached blocks exist,
+        evict just enough (LRU) and retry."""
+        while True:
+            try:
+                rid = self.sched.admit_next()
+            except SchedulerExhausted:
+                if not self._evict_for_admission():
+                    raise
+                continue
+            if rid is not None:
+                return rid
+            if not self._evict_for_admission():
+                return None
+
+    def _evict_for_admission(self) -> bool:
+        if (
+            self.prefix_cache is None
+            or not self.prefix_cache.num_evictable
+            or not self.sched.num_waiting
+            # admit_next() returning None conflates "no free slot" with
+            # "block shortfall"; when every slot is busy, eviction cannot
+            # admit anything and would only flush warm prefixes the next
+            # turn needs.
+            or self.sched.num_running >= self.config.max_num_seqs
+        ):
+            return False
+        # Worst-case shortfall over waiting requests: evicting a few
+        # blocks too many only costs cache entries, never correctness.
+        need = 0
+        for request in self._requests.values():
+            if request.state is not RequestState.WAITING:
+                continue
+            short = self.kv.blocks_needed(request.num_tokens + 1) - len(
+                self.sched.block_row(request.request_id)
+            )
+            need = max(need, short)
+        return self._evict_cached_blocks(need - self.sched.num_free_blocks) > 0
+
+    def _evict_cached_blocks(self, shortfall: int) -> int:
+        """Evict up to ``shortfall`` LRU cache blocks into the scheduler's
+        free list; returns how many were actually freed."""
+        if self.prefix_cache is None or shortfall <= 0:
+            return 0
+        freed = self.prefix_cache.evict(shortfall)
+        if freed:
+            self.sched.release_blocks(freed)
+            self._stats['prefix_evicted_blocks'] += len(freed)
+        return len(freed)
 
     def _prefill_batch_cap(self, bucket: int) -> int:
         """Largest pow2 batch for this bucket under the prefill caps.
@@ -716,8 +915,25 @@ class LLMEngine:
             block_rows_dev,
             lengths_dev,
         )
-        # First token of each sequence, sampled from its last prompt
-        # position; padding rows sample too but are dropped here.
+        # Full prompt blocks just entered the paged cache — adopt them
+        # into the prefix cache BEFORE emission (a max_tokens=1 request
+        # finishes inside _emit_prefill, after which its row is gone).
+        for request in requests:
+            self._insert_prompt_blocks(request)
+        return self._emit_prefill(requests, last_logits, b, defer_to)
+
+    def _emit_prefill(
+        self,
+        requests: list[Request],
+        last_logits,
+        b: int,
+        defer_to,
+    ) -> list[tuple[int, int]]:
+        """Sample + emit each prefilled request's first token.
+
+        First token of each sequence, sampled from its last prompt
+        position; padding rows sample too but are dropped here.
+        """
         slots: list[Request | None] = list(requests) + [None] * (
             b - len(requests)
         )
@@ -757,6 +973,184 @@ class LLMEngine:
             plan.append((i, rid, 1))
         defer_to.append({'tokens': tok_dev[None, :], 'plan': plan})
         return []
+
+    # ---------------------------------------------- prefix-cached prefill
+    def _run_prefill_paged(
+        self, requests: list[Request], defer_to=None
+    ) -> list[tuple[int, int]]:
+        """Prefill requests through the paged-context path: cache hits
+        prefill only their uncached tail, and tails longer than
+        ``prefill_chunk_tokens`` split into sequential bucketed chunks."""
+        if not requests:
+            return []
+        self._resolve_cow(
+            [r for r in requests if r.cow_src_block is not None]
+        )
+        emitted: list[tuple[int, int]] = []
+        chunk = self.config.prefill_chunk_tokens
+        whole: dict[int, list[Request]] = {}
+        chunked: list[Request] = []
+        for request in requests:
+            tail = request.num_tokens - request.num_cached_tokens
+            if chunk and tail > chunk:
+                chunked.append(request)
+            else:
+                bucket = pick_bucket(tail, self.prefill_buckets)
+                whole.setdefault(bucket, []).append(request)
+        for bucket, rs in sorted(whole.items()):
+            cap = self._prefill_batch_cap(bucket)
+            for i in range(0, len(rs), cap):
+                batch = rs[i : i + cap]
+                spans = [
+                    (
+                        r,
+                        r.num_cached_tokens,
+                        r.num_tokens - r.num_cached_tokens,
+                    )
+                    for r in batch
+                ]
+                emitted.extend(
+                    self._dispatch_prefill_paged(spans, bucket, defer_to)
+                )
+        for request in chunked:
+            emitted.extend(self._run_prefill_chunked(request, defer_to))
+        return emitted
+
+    def _run_prefill_chunked(
+        self, request: Request, defer_to=None
+    ) -> list[tuple[int, int]]:
+        """Prefill one long uncached tail as sequential bucketed chunks.
+
+        Each chunk attends over the KV already in the paged cache (the
+        cached prefix plus earlier chunks), so splitting is exact. Only
+        the final chunk samples; between chunks the pipelined loop may
+        retire an in-flight decode window (``_drain_hook``) so a long
+        prompt cannot stall decode for its whole prefill.
+        """
+        chunk = self.config.prefill_chunk_tokens
+        start = request.num_cached_tokens
+        total = request.num_tokens
+        emitted: list[tuple[int, int]] = []
+        while start < total:
+            ntok = min(chunk, total - start)
+            final = start + ntok >= total
+            bucket = pick_bucket(ntok, self.prefill_buckets)
+            self._stats['prefill_chunks'] += 1
+            _metrics.ENGINE_PREFILL_CHUNKS.inc()
+            _metrics.ENGINE_PREFILL_CHUNK_TOKENS.observe(ntok)
+            emitted.extend(
+                self._dispatch_prefill_paged(
+                    [(request, start, ntok)], bucket, defer_to, sample=final
+                )
+            )
+            start += ntok
+            if not final and self._drain_hook is not None:
+                self._drain_hook()
+        return emitted
+
+    def _dispatch_prefill_paged(
+        self,
+        spans: list[tuple[Request, int, int]],
+        bucket: int,
+        defer_to=None,
+        sample: bool = True,
+    ) -> list[tuple[int, int]]:
+        """One padded paged-context prefill dispatch.
+
+        ``spans`` is ``[(request, start_token, num_tokens)]``; every span's
+        K/V lands in the request's own blocks at absolute positions, and
+        its queries attend to everything before them through the paged
+        cache. ``sample=False`` (intermediate chunks) skips emission.
+        """
+        requests = [r for r, _, _ in spans]
+        _metrics.ENGINE_PREFILL_BATCH.observe(len(requests))
+        self._stats['prefill_dispatches'] += 1
+        _metrics.ENGINE_PREFILL_DISPATCHES.inc()
+        b = 1
+        while b < len(spans):
+            b *= 2
+        ids = np.zeros((b, bucket), np.int32)
+        positions = np.zeros((b, bucket), np.int32)
+        context_lens = np.ones((b,), np.int32)
+        tail_lens = np.zeros((b,), np.int32)
+        block_rows = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        max_pos = self.config.max_model_len - 1
+        for i, (request, start, ntok) in enumerate(spans):
+            toks = (request.prompt_ids + request.output_ids)[
+                start : start + ntok
+            ]
+            ids[i, :ntok] = toks
+            # Padding columns clamp to max_model_len-1 so the RoPE table
+            # gather stays in range; their writes are masked to trash.
+            positions[i] = np.minimum(start + np.arange(bucket), max_pos)
+            context_lens[i] = start + ntok
+            tail_lens[i] = ntok
+            block_rows[i] = self._block_row(request.request_id)
+        (
+            ids_dev,
+            positions_dev,
+            block_rows_dev,
+            context_lens_dev,
+            tail_lens_dev,
+        ) = self._put_many(
+            ids, positions, block_rows, context_lens, tail_lens
+        )
+        last_logits, self.kv.k, self.kv.v = self._prefill_paged(
+            self.params,
+            ids_dev,
+            positions_dev,
+            self.kv.k,
+            self.kv.v,
+            block_rows_dev,
+            context_lens_dev,
+            tail_lens_dev,
+        )
+        if not sample:
+            return []
+        for request in requests:
+            self._insert_prompt_blocks(request)
+        return self._emit_prefill(requests, last_logits, b, defer_to)
+
+    def _resolve_cow(self, requests: list[Request]) -> None:
+        """Copy-on-write for aligned full-cover hits: duplicate each
+        shared final block into the request's first OWNED block (one
+        batched device copy across all layers), so the last prompt
+        token's K/V write cannot touch a block other requests read."""
+        if not requests:
+            return
+        srcs: list[int] = []
+        dsts: list[int] = []
+        for request in requests:
+            row = self.sched.block_row(request.request_id)
+            dsts.append(row[request.num_borrowed_blocks])
+            srcs.append(request.cow_src_block)
+            request.cow_src_block = None
+        self._stats['prefix_cow_copies'] += len(srcs)
+        _metrics.PREFIX_COW_COPIES.inc(len(srcs))
+        src_dev, dst_dev = self._put_many(
+            np.asarray(srcs, np.int32), np.asarray(dsts, np.int32)
+        )
+        self.kv.k, self.kv.v = self._cow_copy(
+            self.kv.k, self.kv.v, src_dev, dst_dev
+        )
+
+    def _insert_prompt_blocks(self, request: Request) -> None:
+        """Adopt this request's freshly prefilled FULL prompt blocks into
+        the prefix cache (first writer wins) and mark them borrowed in the
+        scheduler so finish/preemption cannot free them."""
+        if self.prefix_cache is None or not request.digests:
+            return
+        rid = request.request_id
+        row = self.sched.block_row(rid)
+        nb = request.num_borrowed_blocks
+        lent = nb
+        for i in range(nb, len(request.digests)):
+            if not self.prefix_cache.insert(rid, request.digests[i], row[i]):
+                break
+            lent = i + 1
+        if lent > nb:
+            self.sched.lend_prefix(rid, lent)
+            request.num_borrowed_blocks = lent
 
     def _block_row(self, rid: int) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -832,20 +1226,27 @@ class LLMEngine:
         covered by in-flight windows (caller should process one).
         """
         k = self.config.decode_steps
+        kmax = self._window_kmax()
+        # Eviction pressure beats preemption: unreferenced cached blocks
+        # are free capacity, so spend those before recompute-preempting a
+        # running sequence.
+        self._evict_cached_blocks(
+            self._reserve_shortfall(kmax) - self.sched.num_free_blocks
+        )
         try:
-            preempted = self.sched.prepare_decode(self._window_kmax())
+            preempted = self.sched.prepare_decode(kmax)
         except SchedulerExhausted as exc:
             # Preemptions performed before the fatal exhaustion are not
             # rolled back; sync their states so a caller that catches and
             # continues sees engine state consistent with the scheduler.
             for rid in exc.preempted:
-                self._requests[rid].state = RequestState.WAITING
+                self._on_preempt(self._requests[rid])
             raise
         for rid in preempted:
             # The pipelined loop drains in-flight windows before any
             # dispatch that could preempt, so victims never have unacked
             # device-side tokens; recompute preemption re-prefills them.
-            self._requests[rid].state = RequestState.WAITING
+            self._on_preempt(self._requests[rid])
         running = [
             (slot, self._requests[rid]) for slot, rid in self.sched.running()
         ]
@@ -936,6 +1337,15 @@ class LLMEngine:
         )
         return {'tokens': tokens, 'plan': plan, 'last_ids': last_ids}
 
+    def _on_preempt(self, request: Request) -> None:
+        request.state = RequestState.WAITING
+        if self.prefix_cache is not None:
+            # Recompute preemption kept only the borrowed (cache-owned)
+            # prefix; everything past it was freed and must re-prefill.
+            request.num_cached_tokens = (
+                request.num_borrowed_blocks * self.config.block_size
+            )
+
     def _process_window(self, window: dict) -> list[tuple[int, int]]:
         """Fetch one window's tokens (the only host sync in the decode
         path) and fold them into request state; post-EOS overshoot tokens
@@ -978,6 +1388,11 @@ class LLMEngine:
         def process_one() -> None:
             self._process_window(inflight.popleft())
 
+        def drain_one() -> None:
+            if inflight:
+                process_one()
+
+        self._drain_hook = drain_one
         try:
             while self.has_unfinished or inflight:
                 # Deferred prefill (opt-in): first tokens stay on device
@@ -993,10 +1408,13 @@ class LLMEngine:
                         process_one()
                     continue
                 # Never let a dispatch preempt while windows are in flight.
+                # Evictable cached blocks count as free capacity first.
                 while inflight and (
-                    self._reserve_shortfall(self._window_kmax())
-                    > self.sched.num_free_blocks
-                ):
+                    short := self._reserve_shortfall(self._window_kmax())
+                    - self.sched.num_free_blocks
+                ) > 0:
+                    if self._evict_cached_blocks(short):
+                        continue
                     process_one()
                 window = self._dispatch_window(self._carried)
                 if window is _DRAIN:
@@ -1018,6 +1436,8 @@ class LLMEngine:
                     inflight.clear()
                     self._unacked.clear()
             raise
+        finally:
+            self._drain_hook = None
 
     def _sample_device(self, logits: jnp.ndarray, slots) -> jnp.ndarray:
         """Sample one token per row on DEVICE (no host sync)."""
@@ -1056,6 +1476,11 @@ class LLMEngine:
         request.state = RequestState.FINISHED
         _metrics.ENGINE_REQUESTS_FINISHED.inc()
         self.sched.finish(request.request_id)
+        if self.prefix_cache is not None:
+            # Drop this request's references; ref==0 blocks become LRU-
+            # evictable but KEEP their KV — that persistence is what makes
+            # the next same-prefix request free.
+            self.prefix_cache.release(request.request_id)
         self._unacked.pop(request.request_id, None)
         del self._requests[request.request_id]
         self._finished[request.request_id] = request
@@ -1082,6 +1507,11 @@ class LLMEngine:
         windows = self._stats.get('decode_windows', 0)
         if windows and loop_s > 0:
             self.telemetry['windows_per_s'] = round(windows / loop_s, 2)
+        lookups = self._stats.get('prefix_lookup_tokens', 0)
+        if lookups:
+            self.telemetry['prefix_hit_rate'] = round(
+                self._stats.get('prefix_hit_tokens', 0) / lookups, 4
+            )
         if n_out:
             self.telemetry['overshoot_frac'] = round(
                 self._stats.get('overshoot_tokens', 0) / n_out, 4

@@ -1,4 +1,4 @@
-"""Paged KV cache: HBM block pool + block allocator (native C++ or Python).
+"""Paged KV cache: HBM block pool, block allocator, automatic prefix cache.
 
 The TPU replacement for vLLM's paged KV memory management (SURVEY.md
 section 2.4 N1): K/V live as ``[L, num_blocks, block_size, N_kv, Hd]`` device
@@ -8,12 +8,22 @@ block — padded scatter writes land there (see ``ops/paged_attention``).
 The allocator is the C++ free-list/refcount implementation in
 ``distllm_tpu/native/block_allocator.cpp`` (ctypes), with a drop-in Python
 fallback when no compiler is available.
+
+:class:`PrefixCache` is the automatic prefix cache (SGLang-style radix
+reuse over full paged blocks; docs/prefix_caching.md): a token-block
+hash-chain → block-id map with per-block request refcounts and LRU
+eviction of unreferenced blocks. It owns the REUSE policy only — physical
+block accounting stays with the scheduler, which marks cache-held blocks
+as a request's "borrowed prefix" (``scheduler.py``).
 """
 
 from __future__ import annotations
 
 import ctypes
-from typing import Protocol
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Protocol, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -108,6 +118,180 @@ class NativeBlockAllocator:
         if lib is not None and handle:
             lib.ba_destroy(handle)
             self._handle = None
+
+
+def hash_block_tokens(
+    parent: bytes | None, tokens: Sequence[int]
+) -> bytes:
+    """Digest of one full token block, chained through its prefix.
+
+    The chain (``h_i = H(h_{i-1} || tokens_i)``) makes a block's digest
+    identify the ENTIRE prefix up to and including it, so a flat
+    digest → block map behaves like a radix trie: matching the longest
+    cached prefix is "walk digests until one misses". sha256 rather than
+    Python ``hash``: digests index physical KV blocks, and a collision
+    would silently serve another prompt's KV.
+    """
+    h = hashlib.sha256(parent or b'')
+    h.update(b','.join(str(int(t)).encode() for t in tokens))
+    return h.digest()
+
+
+def block_digests(
+    prompt_ids: Sequence[int], block_size: int
+) -> list[bytes]:
+    """Chained digests for every FULL block of ``prompt_ids``.
+
+    Partial trailing blocks are not hashable (their content is not yet
+    final — later tokens land in them), so reuse granularity is whole
+    blocks; the COW path in the engine covers the aligned full-cover case.
+    """
+    digests: list[bytes] = []
+    parent: bytes | None = None
+    for start in range(0, len(prompt_ids) - block_size + 1, block_size):
+        parent = hash_block_tokens(
+            parent, prompt_ids[start : start + block_size]
+        )
+        digests.append(parent)
+    return digests
+
+
+@dataclass
+class _CacheEntry:
+    block_id: int
+    refcount: int = 0  # live requests referencing this block
+    holders: set = field(default_factory=set)  # rids, for shared-block gauge
+
+
+class PrefixCache:
+    """Digest-chain → KV-block map with refcounts and LRU eviction.
+
+    Ownership protocol (engine-driven; see docs/prefix_caching.md):
+
+    - ``acquire(rid, digests)`` — longest-prefix match; increfs every
+      matched block for ``rid`` and returns the block ids. Matched blocks
+      leave the evictable LRU.
+    - ``insert(rid, digest, block_id)`` — adopt a freshly prefilled prompt
+      block (the engine then marks it borrowed in the scheduler via
+      ``lend_prefix``). Returns False when the digest is already cached
+      (first writer wins; the caller keeps its duplicate block private).
+    - ``release(rid)`` — drop every reference ``rid`` holds; blocks whose
+      refcount reaches zero become LRU-evictable but KEEP their KV
+      contents (that persistence is the whole point).
+    - ``evict(max_blocks)`` — pop least-recently-used evictable blocks and
+      return their ids for the scheduler's free list.
+
+    Purely host-side bookkeeping: never touches device arrays and never
+    frees blocks itself.
+    """
+
+    def __init__(self, block_size: int) -> None:
+        self.block_size = block_size
+        self._entries: dict[bytes, _CacheEntry] = {}
+        # digest -> block_id for refcount==0 entries, LRU order (oldest
+        # first). Entries stay in _entries while evictable.
+        self._evictable: 'OrderedDict[bytes, int]' = OrderedDict()
+        self._held: dict[int, list[bytes]] = {}  # rid -> digests referenced
+        self.stats = {'hit_blocks': 0, 'evictions': 0, 'inserts': 0}
+
+    # ------------------------------------------------------------- lookup
+    def match(self, digests: Sequence[bytes]) -> list[int]:
+        """Block ids of the longest cached prefix of ``digests`` (no ref)."""
+        blocks: list[int] = []
+        for digest in digests:
+            entry = self._entries.get(digest)
+            if entry is None:
+                break
+            blocks.append(entry.block_id)
+        return blocks
+
+    def acquire(self, rid: int, digests: Sequence[bytes]) -> list[int]:
+        """Longest-prefix match + incref each matched block for ``rid``."""
+        blocks: list[int] = []
+        matched: list[bytes] = []
+        for digest in digests:
+            entry = self._entries.get(digest)
+            if entry is None:
+                break
+            entry.refcount += 1
+            entry.holders.add(rid)
+            self._evictable.pop(digest, None)
+            matched.append(digest)
+            blocks.append(entry.block_id)
+        if matched:
+            self._held.setdefault(rid, []).extend(matched)
+        self.stats['hit_blocks'] += len(blocks)
+        self._publish()
+        return blocks
+
+    # ------------------------------------------------------------- insert
+    def insert(self, rid: int, digest: bytes, block_id: int) -> bool:
+        """Adopt ``block_id`` for ``digest``; ``rid`` holds the first ref.
+
+        False when the digest is already cached — the caller's physical
+        block stays private to it (freed by the scheduler at finish).
+        """
+        if digest in self._entries:
+            return False
+        self._entries[digest] = _CacheEntry(
+            block_id, refcount=1, holders={rid}
+        )
+        self._held.setdefault(rid, []).append(digest)
+        self.stats['inserts'] += 1
+        self._publish()
+        return True
+
+    # ------------------------------------------------------------ release
+    def release(self, rid: int) -> None:
+        """Drop every reference ``rid`` holds (finish/abort path)."""
+        for digest in self._held.pop(rid, []):
+            entry = self._entries.get(digest)
+            if entry is None:
+                continue  # evicted while... cannot happen (ref pinned)
+            entry.refcount -= 1
+            entry.holders.discard(rid)
+            if entry.refcount <= 0:
+                # Most-recently released = most likely to be reused next:
+                # append to the MRU end.
+                self._evictable[digest] = entry.block_id
+        self._publish()
+
+    # ------------------------------------------------------------- evict
+    def evict(self, max_blocks: int) -> list[int]:
+        """Pop up to ``max_blocks`` LRU evictable blocks; caller returns
+        them to the scheduler free list."""
+        freed: list[int] = []
+        while self._evictable and len(freed) < max_blocks:
+            digest, block_id = self._evictable.popitem(last=False)
+            del self._entries[digest]
+            freed.append(block_id)
+        if freed:
+            from distllm_tpu.observability import instruments as _m
+
+            _m.PREFIX_EVICTIONS.inc(len(freed))
+        self.stats['evictions'] += len(freed)
+        self._publish()
+        return freed
+
+    # -------------------------------------------------------------- state
+    @property
+    def num_cached(self) -> int:
+        return len(self._entries)
+
+    @property
+    def num_evictable(self) -> int:
+        return len(self._evictable)
+
+    @property
+    def num_shared(self) -> int:
+        return sum(1 for e in self._entries.values() if len(e.holders) >= 2)
+
+    def _publish(self) -> None:
+        from distllm_tpu.observability import instruments as _m
+
+        _m.PREFIX_CACHED_BLOCKS.set(self.num_cached)
+        _m.PREFIX_EVICTABLE_BLOCKS.set(self.num_evictable)
+        _m.PREFIX_SHARED_BLOCKS.set(self.num_shared)
 
 
 def make_allocator(num_blocks: int, prefer_native: bool = True) -> BlockAllocator:

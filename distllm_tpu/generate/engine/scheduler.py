@@ -13,12 +13,24 @@ SURVEY.md §2.4 N1) extracted behind one interface:
 Policy contract (both implementations, tested in lockstep):
 
 - ``admit_next`` pops the waiting-queue head into the lowest free slot when
-  blocks for ``num_tokens + 1`` are available (all-or-nothing).
+  blocks for ``num_tokens + 1`` are available (all-or-nothing). Blocks a
+  request already carries (a borrowed prefix-cache prefix) count toward
+  that budget: only the shortfall is allocated.
 - ``prepare_decode(k)`` guarantees every running sequence can take ``k``
   more tokens (k > 1 backs multi-step fused decode windows), preempting
   the youngest (highest rid) on OOM — recompute preemption: blocks freed,
   request to the FRONT of the waiting queue.
 - Block 0 is the reserved trash block and is never allocated.
+
+Borrowed prefixes (automatic prefix caching, docs/prefix_caching.md): a
+request's block row may start with blocks OWNED BY THE PREFIX CACHE —
+attached at ``add`` (cache hit) or marked afterwards with ``lend_prefix``
+(this request's freshly prefilled prompt blocks entering the cache). The
+scheduler never returns borrowed blocks to its free list: ``finish`` and
+preemption free only the owned tail, and the cache hands evicted blocks
+back through ``release_blocks``. Refcounts/eviction policy live in
+``kv_cache.PrefixCache``; the scheduler only knows "the first N blocks of
+this row are not mine to free".
 """
 
 from __future__ import annotations
@@ -44,7 +56,9 @@ class SchedulerExhausted(RuntimeError):
 
 
 class Scheduler(Protocol):
-    def add(self, rid: int, num_tokens: int) -> None: ...
+    def add(
+        self, rid: int, num_tokens: int, cached_blocks: 'list[int] | tuple' = ()
+    ) -> None: ...
 
     def admit_next(self) -> int | None: ...
 
@@ -53,6 +67,12 @@ class Scheduler(Protocol):
     def append_token(self, rid: int) -> None: ...
 
     def finish(self, rid: int) -> None: ...
+
+    def lend_prefix(self, rid: int, num_blocks: int) -> None: ...
+
+    def release_blocks(self, blocks: list[int]) -> None: ...
+
+    def num_borrowed(self, rid: int) -> int: ...
 
     def slot(self, rid: int) -> int: ...
 
@@ -79,6 +99,9 @@ class _PyRequest:
     num_tokens: int
     blocks: list[int] = field(default_factory=list)
     slot: int = -1
+    # First `num_borrowed` blocks are prefix-cache property: never freed
+    # to the scheduler free list, and they survive recompute preemption.
+    num_borrowed: int = 0
 
 
 class PyScheduler:
@@ -96,10 +119,17 @@ class PyScheduler:
     def _blocks_needed(self, tokens: int) -> int:
         return (tokens + self._block_size - 1) // self._block_size
 
-    def add(self, rid: int, num_tokens: int) -> None:
+    def add(
+        self, rid: int, num_tokens: int, cached_blocks: 'list[int] | tuple' = ()
+    ) -> None:
         if rid in self._requests:
             raise ValueError(f'duplicate request id {rid}')
-        self._requests[rid] = _PyRequest(rid, num_tokens)
+        self._requests[rid] = _PyRequest(
+            rid,
+            num_tokens,
+            blocks=list(cached_blocks),
+            num_borrowed=len(cached_blocks),
+        )
         self._waiting.append(rid)
 
     def admit_next(self) -> int | None:
@@ -111,28 +141,33 @@ class PyScheduler:
             return None
         rid = self._waiting[0]
         req = self._requests[rid]
-        needed = self._blocks_needed(req.num_tokens + 1)
-        if needed > len(self._free):
+        # Borrowed (and preemption-surviving) blocks already cover part of
+        # the budget; only the shortfall comes out of the free list.
+        short = self._blocks_needed(req.num_tokens + 1) - len(req.blocks)
+        if short > len(self._free):
             if self.num_running == 0:
                 raise SchedulerExhausted(
-                    f'request {rid} needs {needed} KV blocks but only '
+                    f'request {rid} needs {short} KV blocks but only '
                     f'{len(self._free)} are free with nothing running; '
                     'increase num_blocks'
                 )
             return None
         self._waiting.popleft()
-        req.blocks = [self._free.pop() for _ in range(needed)]
+        req.blocks.extend(self._free.pop() for _ in range(short))
         req.slot = slot
         self._slots[slot] = rid
         return rid
+
+    def _free_owned(self, req: _PyRequest) -> None:
+        self._free.extend(req.blocks[req.num_borrowed :])
+        del req.blocks[req.num_borrowed :]
 
     def _preempt_youngest(self) -> int | None:
         running = [r for r in self._slots if r >= 0]
         if len(running) <= 1:
             return None
         victim = self._requests[max(running)]
-        self._free.extend(victim.blocks)
-        victim.blocks = []
+        self._free_owned(victim)
         self._slots[victim.slot] = -1
         victim.slot = -1
         self._waiting.appendleft(victim.rid)
@@ -173,13 +208,33 @@ class PyScheduler:
 
     def finish(self, rid: int) -> None:
         req = self._requests.pop(rid)
-        self._free.extend(req.blocks)
+        # Borrowed prefix blocks belong to the prefix cache; only the
+        # owned tail returns to the free list.
+        self._free.extend(req.blocks[req.num_borrowed :])
         if req.slot >= 0:
             self._slots[req.slot] = -1
         try:
             self._waiting.remove(rid)
         except ValueError:
             pass
+
+    def lend_prefix(self, rid: int, num_blocks: int) -> None:
+        """Extend ``rid``'s borrowed prefix to ``num_blocks`` blocks total
+        (the prefix cache adopted this request's freshly prefilled prompt
+        blocks). Idempotent for smaller values; never exceeds the row."""
+        req = self._requests[rid]
+        if num_blocks > len(req.blocks):
+            raise ValueError(
+                f'cannot lend {num_blocks} blocks of a {len(req.blocks)}-row'
+            )
+        req.num_borrowed = max(req.num_borrowed, num_blocks)
+
+    def release_blocks(self, blocks: list[int]) -> None:
+        """Return cache-evicted blocks to the free list."""
+        self._free.extend(blocks)
+
+    def num_borrowed(self, rid: int) -> int:
+        return self._requests[rid].num_borrowed
 
     def slot(self, rid: int) -> int:
         return self._requests[rid].slot
@@ -223,6 +278,28 @@ class NativeScheduler:
         lib.sched_destroy.argtypes = [ctypes.c_void_p]
         lib.sched_add.restype = ctypes.c_int32
         lib.sched_add.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
+        lib.sched_add_cached.restype = ctypes.c_int32
+        lib.sched_add_cached.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.sched_lend_prefix.restype = ctypes.c_int32
+        lib.sched_lend_prefix.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        lib.sched_release_blocks.restype = ctypes.c_int32
+        lib.sched_release_blocks.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.sched_num_borrowed.restype = ctypes.c_int32
+        lib.sched_num_borrowed.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.sched_admit_next.restype = ctypes.c_int64
         lib.sched_admit_next.argtypes = [ctypes.c_void_p]
         lib.sched_prepare_decode_k.restype = ctypes.c_int32
@@ -270,8 +347,16 @@ class NativeScheduler:
         self._max_num_seqs = max_num_seqs
         self._num_blocks = num_blocks
 
-    def add(self, rid: int, num_tokens: int) -> None:
-        rc = self._lib.sched_add(self._handle, rid, num_tokens)
+    def add(
+        self, rid: int, num_tokens: int, cached_blocks: 'list[int] | tuple' = ()
+    ) -> None:
+        if cached_blocks:
+            arr = (ctypes.c_int32 * len(cached_blocks))(*cached_blocks)
+            rc = self._lib.sched_add_cached(
+                self._handle, rid, num_tokens, arr, len(cached_blocks)
+            )
+        else:
+            rc = self._lib.sched_add(self._handle, rid, num_tokens)
         if rc == -2:
             raise ValueError(f'duplicate request id {rid}')
         if rc != 0:
@@ -308,6 +393,28 @@ class NativeScheduler:
     def finish(self, rid: int) -> None:
         if self._lib.sched_finish(self._handle, rid) != 0:
             raise KeyError(rid)
+
+    def lend_prefix(self, rid: int, num_blocks: int) -> None:
+        rc = self._lib.sched_lend_prefix(self._handle, rid, num_blocks)
+        if rc == -1:
+            raise KeyError(rid)
+        if rc != 0:
+            raise ValueError(
+                f'cannot lend {num_blocks} blocks of request {rid}\'s row'
+            )
+
+    def release_blocks(self, blocks: list[int]) -> None:
+        if not blocks:
+            return
+        arr = (ctypes.c_int32 * len(blocks))(*blocks)
+        if self._lib.sched_release_blocks(self._handle, arr, len(blocks)) != 0:
+            raise RuntimeError('sched_release_blocks failed')
+
+    def num_borrowed(self, rid: int) -> int:
+        n = int(self._lib.sched_num_borrowed(self._handle, rid))
+        if n < 0:
+            raise KeyError(rid)
+        return n
 
     def slot(self, rid: int) -> int:
         return int(self._lib.sched_slot(self._handle, rid))
@@ -378,8 +485,10 @@ class InstrumentedScheduler:
         self._m.SCHED_QUEUE_DEPTH.set(self._inner.num_waiting)
         self._m.SCHED_RUNNING.set(self._inner.num_running)
 
-    def add(self, rid: int, num_tokens: int) -> None:
-        self._inner.add(rid, num_tokens)
+    def add(
+        self, rid: int, num_tokens: int, cached_blocks: 'list[int] | tuple' = ()
+    ) -> None:
+        self._inner.add(rid, num_tokens, cached_blocks)
         self._sync()
 
     def admit_next(self) -> int | None:
@@ -414,6 +523,17 @@ class InstrumentedScheduler:
     def finish(self, rid: int) -> None:
         self._inner.finish(rid)
         self._sync()
+
+    def lend_prefix(self, rid: int, num_blocks: int) -> None:
+        # No _sync: lending only re-labels ownership — occupancy unchanged.
+        self._inner.lend_prefix(rid, num_blocks)
+
+    def release_blocks(self, blocks: list[int]) -> None:
+        self._inner.release_blocks(blocks)
+        self._sync()
+
+    def num_borrowed(self, rid: int) -> int:
+        return self._inner.num_borrowed(rid)
 
     def slot(self, rid: int) -> int:
         return self._inner.slot(rid)

@@ -69,6 +69,19 @@ class TpuGeneratorConfig(BaseConfig):
         description='Unroll the decode layer scan (folds stacked-weight '
         'slices into the matmuls; longer one-time compile).',
     )
+    enable_prefix_cache: bool | None = Field(
+        default=None,
+        description='Automatic prefix caching: reuse KV blocks across '
+        'requests sharing a block-aligned prompt prefix (RAG system '
+        'prompts, MCQA stems) — prefill runs only on the uncached tail.',
+    )
+    prefill_chunk_tokens: int | None = Field(
+        default=None,
+        ge=0,
+        description='Split uncached prefill tails longer than this into '
+        'sequential chunks so one long prompt cannot stall decode '
+        '(0 disables chunking).',
+    )
 
     @model_validator(mode='after')
     def _xor_top_p_min_p(self) -> 'TpuGeneratorConfig':
@@ -204,6 +217,8 @@ class TpuGenerator:
                         ('decode_steps', config.decode_steps),
                         ('sampling_top_window', config.sampling_top_window),
                         ('decode_layer_unroll', config.decode_layer_unroll),
+                        ('enable_prefix_cache', config.enable_prefix_cache),
+                        ('prefill_chunk_tokens', config.prefill_chunk_tokens),
                     )
                     if value is not None
                 },

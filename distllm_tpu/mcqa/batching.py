@@ -19,6 +19,12 @@ from typing import Callable
 @dataclass
 class _Pending:
     prompt: str
+    # Requests sharing a prefix_hint (e.g. one MCQA question's stem, sent
+    # once per answer choice) are kept ADJACENT within a batch so the
+    # server engine's automatic prefix cache (docs/prefix_caching.md) sees
+    # the shared stem back-to-back and reuses its KV blocks.
+    prefix_hint: str = ''
+    arrival: int = 0
     event: threading.Event = field(default_factory=threading.Event)
     result: str | None = None
     error: Exception | None = None
@@ -42,6 +48,7 @@ class BatchingClient:
         self.batch_size = batch_size
         self.batch_timeout = batch_timeout
         self._queue: list[_Pending] = []
+        self._arrivals = 0
         self._cond = threading.Condition()
         self._closed = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -49,11 +56,21 @@ class BatchingClient:
         self.batches_sent = 0
         self.requests_sent = 0
 
-    def generate(self, prompt: str, timeout: float | None = None) -> str:
-        pending = _Pending(prompt)
+    def generate(
+        self,
+        prompt: str,
+        timeout: float | None = None,
+        prefix_hint: str = '',
+    ) -> str:
+        """``prefix_hint`` marks prompts that share a cacheable prefix
+        (same hint = same stem): hinted prompts are grouped adjacently
+        within each batch so a prefix-caching server reuses their KV."""
+        pending = _Pending(prompt, prefix_hint=prefix_hint)
         with self._cond:
             if self._closed:
                 raise RuntimeError('BatchingClient is closed')
+            pending.arrival = self._arrivals
+            self._arrivals += 1
             self._queue.append(pending)
             self._cond.notify()
         if not pending.event.wait(timeout):
@@ -91,6 +108,16 @@ class BatchingClient:
                 del self._queue[: self.batch_size]
                 if not batch:
                     continue
+                # Group shared-stem prompts adjacently (stable on arrival
+                # order, un-hinted prompts keep their relative ordering).
+                # The engine's prefix match runs at request-add time, so
+                # prompts inside ONE server batch all miss a brand-new
+                # stem; adjacency makes same-stem prompts land in the same
+                # or consecutive server batches, so every batch after the
+                # stem's first prefill hits the cache — and keeps the
+                # stem's blocks hot (most-recently-used) against eviction.
+                if any(p.prefix_hint for p in batch):
+                    batch.sort(key=lambda p: (p.prefix_hint, p.arrival))
             self._dispatch(batch)
             self.batches_sent += 1
             self.requests_sent += len(batch)

@@ -147,7 +147,12 @@ class RagAnswerer:
 
         # No outer retry: the transport (ApiGenerator._chat) already does
         # exponential backoff; a second layer here would multiply attempts.
-        response = self.client.generate(prompt, timeout=600)
+        # prefix_hint: per-choice prompts of one question share the same
+        # retrieval context + stem — batching them adjacently lets a
+        # prefix-caching server prefill the stem once.
+        response = self.client.generate(
+            prompt, timeout=600, prefix_hint=question_hash(question)
+        )
         return {'answer': response, 'retrieval': retrieval_log, 'prompt': prompt}
 
 

@@ -10,7 +10,6 @@ live in ``distllm_tpu.ops`` and slot in via the ``attn_impl`` argument.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax
@@ -50,7 +49,12 @@ def rms_norm(
     return (normed * w).astype(dtype)
 
 
-def dense(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None) -> jnp.ndarray:
+def dense(
+    x: jnp.ndarray,
+    kernel,
+    bias: jnp.ndarray | None = None,
+    qmm_backend: str | None = None,
+) -> jnp.ndarray:
     """``x @ kernel (+ bias)`` with kernel laid out ``[in, out]``.
 
     ``kernel`` may be a quantized :class:`~distllm_tpu.ops.quantization.
@@ -63,15 +67,20 @@ def dense(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None) -> jnp.ndarra
     :func:`distllm_tpu.ops.quantized_matmul.int8_dense`, which keeps the
     weight int8 across HBM (scale applied to the dot's OUTPUT, convert
     fused into the weight stream). Measured motivation and tier choice in
-    that module's docstring; override with
-    ``DISTLLM_QMM_BACKEND=auto|pallas|xla|interpret`` (read at import).
+    that module's docstring. ``qmm_backend`` pins the tier for THIS call;
+    ``None`` falls back to the process default
+    (``DISTLLM_QMM_BACKEND=auto|pallas|xla|interpret``, read at import) at
+    trace time — serving paths that validated the tier up front (the
+    engine's TP-mesh check) must pass their resolved value explicitly so a
+    later process-global change cannot re-route traced-at-serve kernels.
     """
     if hasattr(kernel, 'dequantize'):
         if getattr(kernel, 'kind', None) == 'int8' and kernel.q.ndim == 2:
             from distllm_tpu.ops import quantized_matmul as _qmm
 
             y = _qmm.int8_dense(
-                x, kernel.q, kernel.scale, backend=_qmm.default_backend()
+                x, kernel.q, kernel.scale,
+                backend=qmm_backend or _qmm.default_backend(),
             )
             if bias is not None:
                 y = y + bias.astype(y.dtype)
@@ -84,16 +93,29 @@ def dense(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None) -> jnp.ndarra
 
 
 def gelu(x: jnp.ndarray) -> jnp.ndarray:
-    """HF-'gelu' (erf) activation, tanh-approximated for bf16 activations.
+    """HF-'gelu': the exact erf form, at every dtype.
+
+    Checkpoints trained with erf-GELU get erf-GELU — dtype does not change
+    the activation math. Deployments that want the cheaper polynomial opt
+    in explicitly with the ``'gelu_tanh'`` activation name (see
+    :func:`gelu_tanh` for the measured trade).
+    """
+    return jax.nn.gelu(x, approximate=False)
+
+
+def gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    """Opt-in tanh-approximated GELU (the HF ``gelu_pytorch_tanh`` form).
 
     The exact erf lowers to a long VPU polynomial that costs 19% of a
     BERT-base embed forward on a v5e (measured: MFU 0.622 exact vs 0.790
-    tanh, ``chipback_r05/probe_embed_ablation.log``). The tanh form's
-    max deviation from erf-GELU (~3e-3, near |x|=2) is BELOW bf16's own
-    representation step there (~8e-3), so for bf16 activations the
-    approximation is exact to serving precision; fp32 keeps the erf.
+    tanh, ``chipback_r05/probe_embed_ablation.log``). The tanh form's max
+    deviation from erf-GELU is ~3e-3 near |x|=2 — the same order as bf16's
+    representation step there, so it is a REAL (if small) numerics change,
+    not a free lunch; that is why it is an explicit activation choice
+    (``hidden_act='gelu_tanh'``) rather than something bf16 turns on
+    implicitly.
     """
-    return jax.nn.gelu(x, approximate=(x.dtype == jnp.bfloat16))
+    return jax.nn.gelu(x, approximate=True)
 
 
 def silu(x: jnp.ndarray) -> jnp.ndarray:
@@ -108,7 +130,8 @@ def softcap(x: jnp.ndarray, cap: float) -> jnp.ndarray:
 
 ACTIVATIONS: dict[str, Callable] = {
     'gelu': gelu,
-    'gelu_new': partial(jax.nn.gelu, approximate=True),
+    'gelu_tanh': gelu_tanh,
+    'gelu_new': gelu_tanh,  # HF's historical alias for the tanh form
     'silu': silu,
     'relu': jax.nn.relu,
 }

@@ -60,6 +60,11 @@ class MistralConfig(BaseConfig):
     # 'all' = every layer uses cfg.sliding_window (Mistral semantics);
     # 'alternating' = gemma2's even-layer-local / odd-layer-global split.
     sliding_window_pattern: Literal['all', 'alternating'] = 'all'
+    # Quantized-matmul tier pinned for every dense() in the forward; None
+    # reads the process default at trace time. The engine resolves this
+    # ONCE at construction (after its TP-mesh compatibility check) so a
+    # later process-global change cannot re-route serving dispatches.
+    qmm_backend: str | None = None
     dtype: str = 'bfloat16'
 
     @property
@@ -235,10 +240,12 @@ def _mlp_block(normed: jnp.ndarray, lp: dict, cfg) -> jnp.ndarray:
         )
         return out[:, 0] if normed.ndim == 2 else out
     act = common.ACTIVATIONS[getattr(cfg, 'activation', 'silu')]
+    qb = getattr(cfg, 'qmm_backend', None)
     return common.dense(
-        act(common.dense(normed, lp['gate']['kernel']))
-        * common.dense(normed, lp['up']['kernel']),
+        act(common.dense(normed, lp['gate']['kernel'], qmm_backend=qb))
+        * common.dense(normed, lp['up']['kernel'], qmm_backend=qb),
         lp['down']['kernel'],
+        qmm_backend=qb,
     )
 
 
@@ -319,6 +326,121 @@ def prefill(
     return _forward(params, cfg, input_ids, attention_mask, collect_kv=True)
 
 
+def prefill_paged(
+    params: dict,
+    cfg: MistralConfig,
+    input_ids: jnp.ndarray,  # [B, S] uncached tail tokens (padded)
+    positions: jnp.ndarray,  # [B, S] absolute position of each tail token
+    k_cache: jnp.ndarray,  # [L, num_blocks, block_size, N_kv, Hd]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    context_lens: jnp.ndarray,  # [B] total valid tokens incl. this tail
+    tail_lens: jnp.ndarray,  # [B] valid tokens in input_ids (0 = pad row)
+    max_table_positions: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prefill an UNCACHED TAIL against KV history already in the paged
+    cache — the prefix-cache hit / chunked-prefill forward
+    (docs/prefix_caching.md).
+
+    Unlike :func:`prefill` (whole prompt, K/V returned for one batched
+    scatter afterwards), the caches ride the layer scan: each layer writes
+    its tail K/V into its cache plane FIRST, then the tail queries attend
+    over the paged cache — cached prefix and own chunk together — via
+    :func:`~distllm_tpu.ops.paged_attention.paged_prefill_attention_xla`.
+    Returns ``(last_logits [B, V] fp32, k_cache, v_cache)`` where
+    ``last_logits`` is sampled at each row's last valid tail position.
+    Positions at or past ``tail_lens`` (padding) write to trash block 0
+    and their logits are garbage the caller discards.
+    """
+    from distllm_tpu.ops.paged_attention import (
+        paged_prefill_attention_xla,
+        write_chunk_kv,
+    )
+
+    b, s = input_ids.shape
+    table_len = max_table_positions or cfg.max_position_embeddings
+    cos, sin = _rope_tables(cfg, table_len)
+    alternating = (
+        getattr(cfg, 'sliding_window_pattern', 'all') == 'alternating'
+    )
+    layer_windows = jnp.where(
+        _layer_window_flags(cfg), cfg.sliding_window or 0, 0
+    ).astype(jnp.int32)
+    valid = jnp.arange(s)[None, :] < tail_lens[:, None]  # [B, S]
+    x = _embed_tokens(params, cfg, input_ids)  # [B, S, H]
+    qb = getattr(cfg, 'qmm_backend', None)
+
+    def layer(carry, xs):
+        x, k_cache, v_cache = carry
+        lp, li, window_l = xs
+        k_cache_l = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
+        v_cache_l = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
+        normed = _norm(x, lp['attn_ln']['scale'], cfg)
+        q = common.split_heads(
+            common.dense(
+                normed, lp['q']['kernel'], lp['q'].get('bias'), qmm_backend=qb
+            ),
+            cfg.num_heads,
+        )
+        k = common.split_heads(
+            common.dense(
+                normed, lp['k']['kernel'], lp['k'].get('bias'), qmm_backend=qb
+            ),
+            cfg.num_kv_heads,
+        )
+        v = common.split_heads(
+            common.dense(
+                normed, lp['v']['kernel'], lp['v'].get('bias'), qmm_backend=qb
+            ),
+            cfg.num_kv_heads,
+        )
+        q = common.apply_rope(q, cos, sin, positions)
+        k = common.apply_rope(k, cos, sin, positions)
+        # Write the tail's K/V first, then attend over the paged cache —
+        # cached prefix and own chunk through one gather (decode's
+        # write-then-attend order, generalized to S queries).
+        k_cache_l, v_cache_l = write_chunk_kv(
+            k_cache_l, v_cache_l, k, v, block_tables, positions, valid
+        )
+        attn = paged_prefill_attention_xla(
+            q, k_cache_l, v_cache_l, block_tables, context_lens, positions,
+            sliding_window=(
+                window_l if alternating else cfg.sliding_window
+            ),
+            scale=getattr(cfg, 'query_scale', None),
+            logit_softcap=getattr(cfg, 'attn_logit_softcap', None),
+        )
+        attn_out = common.dense(
+            common.merge_heads(attn), lp['o']['kernel'], qmm_backend=qb
+        )
+        if getattr(cfg, 'post_norms', False):
+            attn_out = _norm(attn_out, lp['post_attn_ln']['scale'], cfg)
+        x = x + attn_out
+        normed2 = _norm(x, lp['mlp_ln']['scale'], cfg)
+        mlp = _mlp_block(normed2, lp, cfg)
+        if getattr(cfg, 'post_norms', False):
+            mlp = _norm(mlp, lp['post_mlp_ln']['scale'], cfg)
+        k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k_cache_l, li, 0)
+        v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v_cache_l, li, 0)
+        return (x + mlp, k_cache, v_cache), None
+
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        layer,
+        (x, k_cache, v_cache),
+        (
+            params['layers'],
+            jnp.arange(cfg.num_layers, dtype=jnp.int32),
+            layer_windows,
+        ),
+    )
+    hidden = _norm(x, params['final_ln']['scale'], cfg)
+    # Only each row's last valid tail position feeds the lm_head ([B, S, V]
+    # logits would waste MXU time and HBM — same policy as prefill).
+    last_idx = jnp.maximum(tail_lens - 1, 0)
+    last_hidden = jnp.take_along_axis(hidden, last_idx[:, None, None], axis=1)
+    return logits(params, cfg, last_hidden)[:, 0], k_cache, v_cache
+
+
 def _forward(
     params, cfg, input_ids, attention_mask, *, collect_kv,
     mesh=None, seq_parallel=None,
@@ -361,16 +483,23 @@ def _forward(
         else:
             mask_l = mask
         normed = _norm(x, lp['attn_ln']['scale'], cfg)
+        qb = getattr(cfg, 'qmm_backend', None)
         q = common.split_heads(
-            common.dense(normed, lp['q']['kernel'], lp['q'].get('bias')),
+            common.dense(
+                normed, lp['q']['kernel'], lp['q'].get('bias'), qmm_backend=qb
+            ),
             cfg.num_heads,
         )
         k = common.split_heads(
-            common.dense(normed, lp['k']['kernel'], lp['k'].get('bias')),
+            common.dense(
+                normed, lp['k']['kernel'], lp['k'].get('bias'), qmm_backend=qb
+            ),
             cfg.num_kv_heads,
         )
         v = common.split_heads(
-            common.dense(normed, lp['v']['kernel'], lp['v'].get('bias')),
+            common.dense(
+                normed, lp['v']['kernel'], lp['v'].get('bias'), qmm_backend=qb
+            ),
             cfg.num_kv_heads,
         )
         q = common.apply_rope(q, cos, sin, positions)
@@ -399,7 +528,9 @@ def _forward(
                 scale=getattr(cfg, 'query_scale', None),
                 logit_softcap=getattr(cfg, 'attn_logit_softcap', None),
             )
-        attn_out = common.dense(common.merge_heads(attn), lp['o']['kernel'])
+        attn_out = common.dense(
+            common.merge_heads(attn), lp['o']['kernel'], qmm_backend=qb
+        )
         if getattr(cfg, 'post_norms', False):
             attn_out = _norm(attn_out, lp['post_attn_ln']['scale'], cfg)
         x = x + attn_out
@@ -510,21 +641,23 @@ def _decode_core(
     # allocates a full stacked output buffer: +1 GB at 7B dims, and one
     # more when a multi-step window scan wraps this — that overflowed the
     # v5e's 16 GB HBM.)
+    qb = getattr(cfg, 'qmm_backend', None)
+
     def layer(carry, xs):
         x, k_cache, v_cache = carry
         lp, li, window_l = xs
         k_cache_l = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
         v_cache_l = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
         normed = _norm(x, lp['attn_ln']['scale'], cfg)
-        q = common.dense(normed, lp['q']['kernel'], lp['q'].get('bias')).reshape(
-            -1, cfg.num_heads, cfg.head_size
-        )
-        k = common.dense(normed, lp['k']['kernel'], lp['k'].get('bias')).reshape(
-            -1, cfg.num_kv_heads, cfg.head_size
-        )
-        v = common.dense(normed, lp['v']['kernel'], lp['v'].get('bias')).reshape(
-            -1, cfg.num_kv_heads, cfg.head_size
-        )
+        q = common.dense(
+            normed, lp['q']['kernel'], lp['q'].get('bias'), qmm_backend=qb
+        ).reshape(-1, cfg.num_heads, cfg.head_size)
+        k = common.dense(
+            normed, lp['k']['kernel'], lp['k'].get('bias'), qmm_backend=qb
+        ).reshape(-1, cfg.num_kv_heads, cfg.head_size)
+        v = common.dense(
+            normed, lp['v']['kernel'], lp['v'].get('bias'), qmm_backend=qb
+        ).reshape(-1, cfg.num_kv_heads, cfg.head_size)
         # RoPE at each sequence's own position ([B, 1, N, Hd] view).
         q = common.apply_rope(q[:, None], cos, sin, positions[:, None])[:, 0]
         k = common.apply_rope(k[:, None], cos, sin, positions[:, None])[:, 0]
@@ -533,7 +666,9 @@ def _decode_core(
         )
         attn = attend(q, k_cache_l, v_cache_l, window_l)
         attn_out = common.dense(
-            attn.reshape(-1, cfg.num_heads * cfg.head_size), lp['o']['kernel']
+            attn.reshape(-1, cfg.num_heads * cfg.head_size),
+            lp['o']['kernel'],
+            qmm_backend=qb,
         )
         if getattr(cfg, 'post_norms', False):
             attn_out = _norm(attn_out, lp['post_attn_ln']['scale'], cfg)
@@ -675,7 +810,9 @@ def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray
         kernel = jnp.asarray(params['embed']).T
     else:
         kernel = jnp.asarray(params['lm_head'])
-    out = common.dense(hidden, kernel).astype(jnp.float32)
+    out = common.dense(
+        hidden, kernel, qmm_backend=getattr(cfg, 'qmm_backend', None)
+    ).astype(jnp.float32)
     if getattr(cfg, 'final_logit_softcap', None) is not None:
         out = common.softcap(out, cfg.final_logit_softcap)
     return out

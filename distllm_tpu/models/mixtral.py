@@ -44,6 +44,8 @@ class MixtralConfig(BaseConfig):
     rms_norm_eps: float = 1e-5
     sliding_window: int | None = None
     tie_word_embeddings: bool = False
+    # Pinned quantized-matmul tier (see MistralConfig.qmm_backend).
+    qmm_backend: str | None = None
     dtype: str = 'bfloat16'
 
     @property
@@ -184,7 +186,9 @@ def logits(params: dict, cfg: MixtralConfig, hidden: jnp.ndarray) -> jnp.ndarray
         kernel = jnp.asarray(params['embed']).T
     else:
         kernel = jnp.asarray(params['lm_head'])
-    return common.dense(hidden, kernel).astype(jnp.float32)
+    return common.dense(
+        hidden, kernel, qmm_backend=getattr(cfg, 'qmm_backend', None)
+    ).astype(jnp.float32)
 
 
 def prefill(params: dict, cfg: MixtralConfig, input_ids, attention_mask):

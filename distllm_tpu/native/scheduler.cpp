@@ -19,6 +19,15 @@
 //     push it to the FRONT of the waiting queue (recompute preemption: it
 //     will re-prefill prompt + generated).
 //   - block 0 is the reserved trash block and is never handed out.
+//   - borrowed prefixes (automatic prefix caching): the first
+//     `num_borrowed` blocks of a request's row are prefix-cache property —
+//     attached at add (cache hit) or marked via sched_lend_prefix (freshly
+//     prefilled prompt blocks adopted by the cache). They are never
+//     returned to the free list here (finish/preemption free only the
+//     owned tail; the cache hands evicted blocks back through
+//     sched_release_blocks), they survive recompute preemption, and they
+//     count toward the admission block budget (only the shortfall is
+//     allocated).
 //
 // C ABI for ctypes; no exceptions across the boundary.
 
@@ -35,6 +44,7 @@ struct Request {
     int32_t num_tokens;  // prompt + generated so far
     std::vector<int32_t> blocks;
     int32_t slot = -1;  // -1 = not running
+    int32_t num_borrowed = 0;  // leading cache-owned blocks (never freed)
 };
 
 struct Scheduler {
@@ -65,9 +75,12 @@ struct Scheduler {
         return b;
     }
 
+    // Free the OWNED tail of a request's row; the borrowed prefix stays
+    // (prefix-cache property — see the policy note above).
     void free_request_blocks(Request& req) {
-        for (int32_t b : req.blocks) free_list.push_back(b);
-        req.blocks.clear();
+        for (size_t i = req.num_borrowed; i < req.blocks.size(); ++i)
+            free_list.push_back(req.blocks[i]);
+        req.blocks.resize(req.num_borrowed);
     }
 
     int32_t free_slot() const {
@@ -139,6 +152,24 @@ int32_t sched_add(void* h, int64_t rid, int32_t num_tokens) {
     return 0;
 }
 
+// sched_add with a borrowed prefix: `cached[0..n_cached)` are prefix-cache
+// blocks covering the request's first n_cached * block_size tokens. They
+// join the row immediately and count toward the admission budget.
+int32_t sched_add_cached(void* h, int64_t rid, int32_t num_tokens,
+                         const int32_t* cached, int32_t n_cached) {
+    auto* s = static_cast<Scheduler*>(h);
+    if (s->requests.count(rid)) return -2;
+    if (n_cached < 0) return -3;
+    Request req;
+    req.rid = rid;
+    req.num_tokens = num_tokens;
+    req.blocks.assign(cached, cached + n_cached);
+    req.num_borrowed = n_cached;
+    s->requests.emplace(rid, std::move(req));
+    s->waiting.push_back(rid);
+    return 0;
+}
+
 // Admit the head of the waiting queue: assign the lowest free slot and
 // allocate blocks for num_tokens + 1. Returns the admitted rid, -1 when
 // nothing can be admitted right now, or -2 when the head request cannot get
@@ -150,12 +181,15 @@ int64_t sched_admit_next(void* h) {
     if (slot < 0) return -1;
     int64_t rid = s->waiting.front();
     Request& req = s->requests[rid];
-    int32_t needed = s->blocks_needed(req.num_tokens + 1);
-    if (needed > s->num_free()) {
+    // Blocks already on the row (borrowed prefix) cover part of the
+    // budget; only the shortfall is allocated.
+    int32_t shortfall = s->blocks_needed(req.num_tokens + 1) -
+                        static_cast<int32_t>(req.blocks.size());
+    if (shortfall > s->num_free()) {
         return s->num_running() == 0 ? -2 : -1;
     }
     s->waiting.pop_front();
-    for (int32_t i = 0; i < needed; ++i) req.blocks.push_back(s->alloc_block());
+    for (int32_t i = 0; i < shortfall; ++i) req.blocks.push_back(s->alloc_block());
     req.slot = slot;
     s->slots[slot] = rid;
     return rid;
@@ -219,6 +253,32 @@ int32_t sched_finish(void* h, int64_t rid) {
     if (w != s->waiting.end()) s->waiting.erase(w);
     s->requests.erase(it);
     return 0;
+}
+
+// Extend rid's borrowed prefix to `n` blocks total (idempotent for
+// smaller n). Returns 0, -1 for an unknown rid, -2 when n exceeds the row.
+int32_t sched_lend_prefix(void* h, int64_t rid, int32_t n) {
+    auto* s = static_cast<Scheduler*>(h);
+    auto it = s->requests.find(rid);
+    if (it == s->requests.end()) return -1;
+    Request& req = it->second;
+    if (n > static_cast<int32_t>(req.blocks.size())) return -2;
+    req.num_borrowed = std::max(req.num_borrowed, n);
+    return 0;
+}
+
+// Return cache-evicted blocks to the free list.
+int32_t sched_release_blocks(void* h, const int32_t* blocks, int32_t n) {
+    auto* s = static_cast<Scheduler*>(h);
+    if (n < 0) return -1;
+    for (int32_t i = 0; i < n; ++i) s->free_list.push_back(blocks[i]);
+    return 0;
+}
+
+int32_t sched_num_borrowed(void* h, int64_t rid) {
+    auto* s = static_cast<Scheduler*>(h);
+    auto it = s->requests.find(rid);
+    return it == s->requests.end() ? -1 : it->second.num_borrowed;
 }
 
 int32_t sched_slot(void* h, int64_t rid) {

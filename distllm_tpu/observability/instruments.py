@@ -74,6 +74,47 @@ KV_HBM_BYTES = _registry.gauge(
     'Device memory held by the paged K/V pool arrays.',
 )
 
+# ----------------------------------------------------------- prefix cache
+PREFIX_HIT_TOKENS = _registry.counter(
+    'distllm_prefix_cache_hit_tokens_total',
+    'Prompt tokens served from cached KV blocks (prefill skipped).',
+)
+PREFIX_LOOKUP_TOKENS = _registry.counter(
+    'distllm_prefix_cache_lookup_tokens_total',
+    'Prompt tokens submitted while the prefix cache was enabled '
+    '(hit rate = hit_tokens / lookup_tokens).',
+)
+PREFIX_CACHED_BLOCKS = _registry.gauge(
+    'distllm_prefix_cache_blocks',
+    'KV blocks currently held by the prefix cache (referenced + evictable).',
+)
+PREFIX_EVICTABLE_BLOCKS = _registry.gauge(
+    'distllm_prefix_cache_evictable_blocks',
+    'Cached blocks with zero request references (LRU eviction candidates).',
+)
+PREFIX_SHARED_BLOCKS = _registry.gauge(
+    'distllm_prefix_cache_shared_blocks',
+    'Cached blocks referenced by two or more live requests right now.',
+)
+PREFIX_EVICTIONS = _registry.counter(
+    'distllm_prefix_cache_evictions_total',
+    'Cached blocks evicted (LRU) back to the allocator under pressure.',
+)
+PREFIX_COW_COPIES = _registry.counter(
+    'distllm_prefix_cache_cow_copies_total',
+    'Copy-on-write block copies (full-cover aligned prefix hits).',
+)
+ENGINE_PREFILL_CHUNKS = _registry.counter(
+    'distllm_engine_prefill_chunks_total',
+    'Chunked-prefill dispatches (uncached tails split under '
+    'prefill_chunk_tokens).',
+)
+ENGINE_PREFILL_CHUNK_TOKENS = _registry.histogram(
+    'distllm_engine_prefill_chunk_tokens',
+    'Valid tokens per chunked-prefill dispatch.',
+    buckets=(16, 32, 64, 128, 256, 512, 1024, 2048),
+)
+
 # ------------------------------------------------------------ scheduler
 SCHED_QUEUE_DEPTH = _registry.gauge(
     'distllm_scheduler_queue_depth',

@@ -57,7 +57,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from distllm_tpu.ops import tpu_compiler_params
 
 _NEG_BIG = -1e9
 
@@ -187,7 +188,7 @@ def encoder_attention(
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, s, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('arbitrary',),
         ),
         interpret=interpret,

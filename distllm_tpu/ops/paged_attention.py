@@ -114,6 +114,66 @@ def paged_attention_xla(
     return out.reshape(b, num_heads, head_dim).astype(q.dtype)
 
 
+def paged_prefill_attention_xla(
+    q: jnp.ndarray,  # [B, S, num_heads, head_dim] tail queries
+    k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    context_lens: jnp.ndarray,  # [B] total valid tokens incl. the tail
+    q_positions: jnp.ndarray,  # [B, S] absolute position of each query
+    sliding_window: 'int | jnp.ndarray | None' = None,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
+) -> jnp.ndarray:
+    """Multi-query attention over paged KV: the prefix-cache / chunked
+    prefill kernel (tail queries attend to cached history + themselves).
+
+    The multi-token sibling of :func:`paged_attention_xla`: each of the
+    ``S`` tail queries per sequence attends to every cached position
+    ``<=`` its own absolute position (the tail's K/V must already be
+    written into the paged blocks — the model writes before attending,
+    exactly like the decode path). Gather + masked fp32 softmax; XLA
+    fuses this well and it runs on CPU for tests. Prefill is compute-
+    bound, so unlike decode there is no Pallas variant.
+    """
+    b, s, num_heads, head_dim = q.shape
+    _, block_size, num_kv_heads, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    group = num_heads // num_kv_heads
+
+    k = k_cache[block_tables].reshape(
+        b, max_blocks * block_size, num_kv_heads, head_dim
+    )
+    v = v_cache[block_tables].reshape(
+        b, max_blocks * block_size, num_kv_heads, head_dim
+    )
+    qg = q.reshape(b, s, num_kv_heads, group, head_dim).astype(jnp.float32)
+    scores = jnp.einsum('bskgd,btkd->bkgst', qg, k.astype(jnp.float32))
+    scores = scores * jnp.float32(
+        scale if scale is not None else head_dim ** -0.5
+    )
+    if logit_softcap is not None:
+        from distllm_tpu.models.common import softcap
+
+        scores = softcap(scores, logit_softcap)
+    kv_pos = jnp.arange(max_blocks * block_size)[None, None, :]  # [1, 1, T]
+    qp = q_positions[:, :, None]  # [B, S, 1]
+    valid = (kv_pos < context_lens[:, None, None]) & (kv_pos <= qp)
+    if sliding_window is not None:
+        # Same window semantics as the dense prefill mask: query at
+        # position p sees keys in (p - window, p]. Traced windows <= 0
+        # disable the clamp (gemma2 alternating layers).
+        windowed = kv_pos > qp - sliding_window
+        if isinstance(sliding_window, int):
+            valid = valid & windowed
+        else:
+            valid = valid & (windowed | (sliding_window <= 0))
+    scores = jnp.where(valid[:, None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bkgst,btkd->bskgd', probs, v.astype(jnp.float32))
+    return out.reshape(b, s, num_heads, head_dim).astype(q.dtype)
+
+
 def _paged_attn_kernel(
     # scalar-prefetch operands (SMEM)
     block_tables_ref,  # [B, max_blocks] int32
@@ -356,6 +416,42 @@ def write_token_kv(
     offsets = positions % block_size
     k_cache = k_cache.at[block_ids, offsets].set(new_k.astype(k_cache.dtype))
     v_cache = v_cache.at[block_ids, offsets].set(new_v.astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+def write_chunk_kv(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    new_k: jnp.ndarray,  # [B, S, num_kv_heads, head_dim] tail K
+    new_v: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    positions: jnp.ndarray,  # [B, S] absolute position per tail token
+    valid: jnp.ndarray,  # [B, S] bool — padding rows/tokens route to trash
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a batch of tail chunks' K/V into their paged blocks.
+
+    The multi-token sibling of :func:`write_token_kv` (prefix-cache tail
+    prefill / chunked prefill): invalid positions write to the reserved
+    trash block 0 — same pad-safety contract as :func:`write_prefill_kv`.
+    """
+    block_size = k_cache.shape[1]
+    b, s = positions.shape
+    block_ids = jnp.where(
+        valid,
+        jnp.take_along_axis(block_tables, positions // block_size, axis=1),
+        0,
+    )
+    offsets = jnp.where(valid, positions % block_size, 0)
+    flat_blocks = block_ids.reshape(-1)
+    flat_offsets = offsets.reshape(-1)
+    k_flat = new_k.reshape(b * s, *new_k.shape[2:])
+    v_flat = new_v.reshape(b * s, *new_v.shape[2:])
+    k_cache = k_cache.at[flat_blocks, flat_offsets].set(
+        k_flat.astype(k_cache.dtype)
+    )
+    v_cache = v_cache.at[flat_blocks, flat_offsets].set(
+        v_flat.astype(v_cache.dtype)
+    )
     return k_cache, v_cache
 
 

@@ -42,6 +42,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distllm_tpu.ops import tpu_compiler_params
+
 BACKENDS = ('auto', 'pallas', 'xla', 'interpret')
 
 _default_backend = os.environ.get('DISTLLM_QMM_BACKEND', 'auto')
@@ -153,8 +155,8 @@ def int8_matmul_pallas(
         out_specs=pl.BlockSpec((m_pad, bn), lambda j, kk: (0, j)),
         out_shape=jax.ShapeDtypeStruct((m_pad, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((m_pad, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=('parallel', 'arbitrary')
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=('parallel', 'arbitrary'),
         ),
         interpret=interpret,
     )(x, q, scale)

@@ -41,7 +41,10 @@ def _sharded_topk(score_fn, row_count, operands, in_specs, k, mesh):
     score matrix) and reduced with one final ``top_k``. Both the exact
     fp32 and the int8 tiers route here so the offset/merge math has one
     home."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 spelling of the same API
+        from jax.experimental.shard_map import shard_map
 
     n_shards = mesh.shape['data']
     shard_rows = row_count // n_shards
@@ -124,6 +127,14 @@ def int8_topk(
             # count those all-zero rows would rank as valid neighbors and
             # leak out-of-range indices to the caller.
             raise ValueError('grouped codes [G, C, H] require n_valid')
+        if mesh is not None and mesh.shape.get('data', 1) > 1:
+            # The grouped scan is a single-device serving layout; silently
+            # ignoring the mesh would score the FULL corpus on every chip
+            # and return duplicate candidates. Mirror the n_valid guard.
+            raise ValueError(
+                'grouped codes [G, C, H] cannot combine with a data-sharded '
+                'mesh; pass flat [N, H] codes for the sharded path'
+            )
         n = n_valid
         k = min(k, n)
         qmax = jnp.abs(queries).max(axis=1)
@@ -352,6 +363,16 @@ def hamming_topk(
             scorer='hamming', k=k,
             n_valid=n, approx=n >= APPROX_TOPK_MIN_ROWS,
         )
+        # approx_max_k's bin maxima can surface -inf-masked padded rows as
+        # candidates when a chunk has fewer valid rows than bins; casting
+        # -(-inf) to int32 is UB in XLA. Clamp those candidates to a finite
+        # max-distance sentinel so callers see an unambiguous "no neighbor"
+        # distance (true distances are <= H) instead of garbage. The
+        # sentinel must be fp32-REPRESENTABLE below 2**31: -(2**31 - 1)
+        # rounds to -2**31 in fp32 and its negation overflows the very
+        # int32 cast this guards; 2**31 - 128 is the largest fp32 value
+        # strictly under INT32_MAX.
+        neg = jnp.maximum(neg, jnp.float32(-2147483520.0))
         return (-neg).astype(jnp.int32), idx
     n = corpus_bits.shape[0]
     k = min(k, n)

@@ -117,6 +117,39 @@ def test_no_raw_print_telemetry():
     )
 
 
+def test_no_direct_block_free_outside_allocator_modules():
+    """KV blocks are freed ONLY by the allocator/scheduler/prefix-cache
+    machinery (``generate/engine/kv_cache.py`` + the scheduler bindings).
+    A stray ``allocator.free(...)`` anywhere else can double-free a block
+    that the prefix cache still maps — corruption that surfaces as another
+    request's KV, long after the bad call. The AST gate forbids any
+    ``X.free(...)`` attribute call in ``distllm_tpu`` outside those two
+    modules (same spirit as the raw-print rule: the dangerous spelling is
+    banned, the sanctioned paths are allowlisted)."""
+    package = REPO / 'distllm_tpu'
+    allowed = {
+        ('generate', 'engine', 'kv_cache.py'),
+        ('generate', 'engine', 'scheduler.py'),
+    }
+    offenders = []
+    for path in sorted(package.rglob('*.py')):
+        if path.relative_to(package).parts in allowed:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == 'free'
+            ):
+                offenders.append(f'{path.relative_to(REPO)}:{node.lineno}')
+    assert not offenders, (
+        'direct .free( calls outside the allocator/cache modules '
+        '(route block lifecycle through the scheduler/PrefixCache):\n'
+        + '\n'.join(offenders)
+    )
+
+
 @pytest.mark.skipif(shutil.which('ruff') is None, reason='ruff not installed')
 def test_ruff():
     proc = subprocess.run(
