@@ -16,6 +16,28 @@ backend in a short-lived subprocess (retrying — round 1 died on a stale
 (``--stage embed`` / ``--stage gen``) so an OOM or backend wedge in one
 stage cannot take down the other, and composes the single output line.
 
+**Crash-proof contract (ISSUE 3 tentpole).** Rounds 3–5 all produced an
+empty official record because this line was composed only after the LAST
+stage. The orchestrator is now built around an incremental on-disk run
+record and a global wall-clock deadline:
+
+- every completed stage's JSON fragment is fsync'd to ``BENCH_partial.jsonl``
+  (plus an atomically-rewritten ``BENCH_snapshot.json``) the moment the
+  stage exits — a later crash can truncate coverage, never zero it;
+- the deadline (``DISTLLM_BENCH_DEADLINE_S``, default 3300 s — safely under
+  a 1 h driver timeout; the driver's ``timeout`` sends SIGTERM, rc 124)
+  caps every per-stage budget and the backend-probe retry ladder, and a
+  SIGALRM fires just before it expires;
+- SIGTERM / SIGALRM / normal exit all emit the SAME driver-contract line,
+  composed from whatever the run record holds — so an external kill still
+  publishes every completed stage;
+- stages run cheapest-first (embed → embed_q → gen → gen_prefix → gen_q:
+  embed warmups are minutes, ``gen_prefix`` reuses ``gen``'s compile cache,
+  and int8 ``gen_q``'s cold warmup — 22–45 min in round 4 — goes last);
+- a failing or SIGTERM'd stage dumps a debug bundle (flight ring, metrics,
+  traces — ``observability.dump_debug_bundle``) so a dead stage still
+  explains itself, and gen stages run under a ``StallWatchdog``.
+
 The reference publishes no numbers (BASELINE.md); ``vs_baseline`` ratios are
 against analytic A100 estimates derived from the reference's production
 configs, stated inline where computed. Zero egress: weights are random-init
@@ -28,6 +50,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -577,18 +600,100 @@ def _chip_peak_flops(device) -> float | None:
 
 # ------------------------------------------------------------ orchestrator
 
+# Cheapest-first: embed warmups are minutes, gen_prefix reuses gen's
+# compile cache (same bf16 7B dims), and int8 gen_q's cold warmup — the
+# round-4 22-45 min outlier — runs last so a deadline truncates the most
+# expensive coverage first, never the headline metrics.
+STAGE_ORDER = ('embed', 'embed_q', 'gen', 'gen_prefix', 'gen_q')
+NOMINAL_BUDGET_S = {
+    'embed': 1200.0,
+    'embed_q': 1200.0,
+    'gen': 2700.0,
+    'gen_prefix': 2700.0,
+    'gen_q': 2700.0,
+}
+GEN_STAGES = frozenset({'gen', 'gen_q', 'gen_prefix'})
+# Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
+# stages stop with ~5 min to spare even if the guess is exact, and the
+# SIGTERM handler is the backstop if the real budget is shorter.
+DEFAULT_DEADLINE_S = 3300.0
 
-def _probe_backend(attempts: int = 6, timeout: int = 150) -> str | None:
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+# Orchestrator state shared with the signal handlers.
+_CURRENT_CHILD: dict = {'proc': None}
+_EMITTED = {'done': False}
+
+
+def _record_paths() -> tuple[str, str]:
+    base = os.environ.get('DISTLLM_BENCH_RECORD_DIR') or _REPO_DIR
+    return (
+        os.path.join(base, 'BENCH_partial.jsonl'),
+        os.path.join(base, 'BENCH_snapshot.json'),
+    )
+
+
+def _bundle_dir(stage: str) -> str:
+    base = os.environ.get('DISTLLM_BENCH_BUNDLE_DIR') or os.path.join(
+        _REPO_DIR, 'bench_debug'
+    )
+    return os.path.join(base, f'{stage}_{os.getpid()}')
+
+
+def _completed_stages(record) -> list[str]:
+    """Stages whose recorded fragment carries metrics, not an error/skip."""
+    done: list[str] = []
+    for entry in record.entries():
+        stage = entry.get('stage')
+        fragment = entry.get('fragment') or {}
+        if (
+            stage in NOMINAL_BUDGET_S
+            and stage not in done
+            and not any(
+                key.endswith(('_error', '_skipped')) for key in fragment
+            )
+        ):
+            done.append(stage)
+    return done
+
+
+def _emit_final(record, base: dict, extra: dict) -> None:
+    """Compose + print the single driver-contract line, exactly once.
+
+    Called from normal exit AND from the SIGTERM/SIGALRM handlers. Must be
+    async-signal-tolerant: it reads the on-disk record (no locks shared
+    with the main thread) and writes stdout directly.
+    """
+    if _EMITTED['done']:
+        return
+    _EMITTED['done'] = True
+    result = dict(base)
+    result.update(record.compose())
+    result.update(extra)
+    result['stages_completed'] = _completed_stages(record)
+    sys.stdout.write(json.dumps(result) + '\n')
+    sys.stdout.flush()
+
+
+def _probe_backend(deadline, record) -> str | None:
     """Confirm the TPU backend initializes, in a killable subprocess.
 
     Round 1's bench died with 'backend UNAVAILABLE' after a wedged earlier
     process; a hung init here is killed by the timeout and retried rather
     than hanging the bench itself. Round 3 saw a pool-side wedged claim
-    hang clients for hours — hence the longer retry ladder (~15 min worst
-    case; a transient wedge is worth waiting out, the metrics are the
-    round's record). Returns None on success, else the error.
+    hang clients for hours — a transient wedge is worth waiting out, BUT
+    the ladder is now capped by a share of the global deadline (it could
+    previously burn ~15 min before any stage ran), and every attempt's
+    outcome lands in the run record. Returns None on success, else the
+    error.
     """
+    attempts = int(os.environ.get('DISTLLM_BENCH_PROBE_ATTEMPTS', '6'))
+    per_attempt_s = float(os.environ.get('DISTLLM_BENCH_PROBE_TIMEOUT_S', '150'))
+    # At most a quarter of what's left (and never more than 15 min): the
+    # probe exists to protect the stages' time, not to consume it.
+    budget_s = min(900.0, 0.25 * deadline.remaining())
+    probe_start = time.monotonic()
     err = 'unknown'
+    attempts_log: list[dict] = []
     # Mirror the stage subprocesses: re-apply JAX_PLATFORMS through the
     # config API so a CPU smoke run probes CPU, not the pinned TPU.
     probe_src = (
@@ -598,39 +703,160 @@ def _probe_backend(attempts: int = 6, timeout: int = 150) -> str | None:
         'print(jax.devices()[0].platform)\n'
     )
     for attempt in range(attempts):
+        left = budget_s - (time.monotonic() - probe_start)
+        if left <= 5.0:
+            err = (
+                f'probe budget exhausted after {attempt} attempts '
+                f'({budget_s:.0f}s share of the deadline): {err}'
+            )
+            attempts_log.append({'attempt': attempt, 'outcome': 'budget_exhausted'})
+            break
+        attempt_start = time.monotonic()
+        outcome: dict = {'attempt': attempt}
         try:
             proc = subprocess.run(
                 [sys.executable, '-c', probe_src],
-                capture_output=True, text=True, timeout=timeout,
+                capture_output=True, text=True,
+                timeout=min(per_attempt_s, left),
             )
             if proc.returncode == 0:
+                outcome.update(
+                    outcome='ok',
+                    elapsed_s=round(time.monotonic() - attempt_start, 1),
+                    platform=proc.stdout.strip()[-40:],
+                )
+                attempts_log.append(outcome)
+                record.record('probe', {'probe_attempts': attempts_log})
                 return None
             err = (proc.stderr or '').strip()[-500:]
+            outcome.update(outcome='error', error=err[-200:])
         except subprocess.TimeoutExpired:
-            err = f'backend init timed out after {timeout}s'
+            err = f'backend init timed out after {min(per_attempt_s, left):.0f}s'
+            outcome.update(outcome='timeout', error=err)
+        outcome['elapsed_s'] = round(time.monotonic() - attempt_start, 1)
+        attempts_log.append(outcome)
+        record.record('probe', {'probe_attempts': attempts_log})
         if attempt < attempts - 1:
-            time.sleep(20 * (attempt + 1))
+            backoff = 20.0 * (attempt + 1)
+            left = budget_s - (time.monotonic() - probe_start)
+            time.sleep(max(0.0, min(backoff, left)))
+    record.record('probe', {'probe_attempts': attempts_log})
     return err
 
 
-def _run_stage(stage: str, timeout: int) -> dict:
-    """Run one stage in a subprocess; parse its single JSON stdout line."""
+def _run_stage(stage: str, timeout: float) -> tuple[dict, str]:
+    """Run one stage in a subprocess; parse its single JSON stdout line.
+
+    Returns ``(fragment, outcome)`` with outcome ok/error/timeout. On
+    timeout the child gets SIGTERM first (its handler dumps a debug
+    bundle — the corpse carries evidence), then SIGKILL after a grace
+    period.
+    """
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), '--stage', stage],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    _CURRENT_CHILD['proc'] = proc
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), '--stage', stage],
-            capture_output=True, text=True, timeout=timeout,
-        )
+        out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        return {f'{stage}_error': f'stage timed out after {timeout}s'}
-    if proc.returncode != 0:
-        tail = (proc.stderr or proc.stdout or '').strip()[-800:]
-        return {f'{stage}_error': tail}
-    for line in reversed(proc.stdout.strip().splitlines()):
+        proc.terminate()  # SIGTERM: the stage dumps its bundle and exits
         try:
-            return json.loads(line)
+            out, err = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        fragment = {f'{stage}_error': f'stage timed out after {timeout:.0f}s'}
+        bundle = _stage_bundle_hint(err)
+        if bundle:
+            fragment[f'{stage}_bundle_dir'] = bundle
+        return fragment, 'timeout'
+    finally:
+        _CURRENT_CHILD['proc'] = None
+    if proc.returncode != 0:
+        fragment = {f'{stage}_error': (err or out or '').strip()[-800:]}
+        bundle = _stage_bundle_hint(err)
+        if bundle:
+            fragment[f'{stage}_bundle_dir'] = bundle
+        return fragment, 'error'
+    for line in reversed((out or '').strip().splitlines()):
+        try:
+            return json.loads(line), 'ok'
         except json.JSONDecodeError:
             continue
-    return {f'{stage}_error': f'no JSON in stage output: {proc.stdout[-300:]}'}
+    return (
+        {f'{stage}_error': f'no JSON in stage output: {(out or "")[-300:]}'},
+        'error',
+    )
+
+
+def _stage_bundle_hint(stderr: str | None) -> str | None:
+    """The stage prints ``[bench-bundle] <dir>`` to stderr when it dumps a
+    debug bundle; surface that path in the run record."""
+    for line in reversed((stderr or '').splitlines()):
+        if line.startswith('[bench-bundle] '):
+            return line[len('[bench-bundle] '):].strip()
+    return None
+
+
+def _run_stage_entry(stage: str) -> None:
+    """``--stage`` subprocess body: run the stage fn, print its fragment.
+
+    Failure paths dump a debug bundle (flight ring + metrics + traces) so
+    a dead stage still explains itself: on exception, AND on the SIGTERM
+    the orchestrator sends at budget expiry. Gen stages additionally run
+    under a StallWatchdog (the engine's flight ring is the progress
+    signal) that dumps a bundle if the chip wedges mid-stage.
+    """
+    from distllm_tpu.observability.flight import (
+        StallWatchdog,
+        dump_debug_bundle,
+    )
+
+    # Smoke-test hook (tests/test_smoke_bench_contract.py): park this stage
+    # before any heavy import so the orchestrator's kill paths can be
+    # exercised in seconds.
+    if os.environ.get('DISTLLM_BENCH_TEST_HANG_STAGE') == stage:
+        while True:
+            time.sleep(1)
+
+    bundle_dir = _bundle_dir(stage)
+
+    def _dump(reason: str) -> None:
+        try:
+            dump_debug_bundle(bundle_dir, reason=reason)
+            print(f'[bench-bundle] {bundle_dir}', file=sys.stderr, flush=True)
+        except Exception:
+            pass
+
+    def _on_sigterm(signum, frame):  # budget kill from the orchestrator
+        _dump(f'{stage}: SIGTERM (stage budget expired)')
+        os._exit(143)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
+    stage_fns = {
+        'embed': _stage_embed,
+        'embed_q': _stage_embed_q,
+        'gen': _stage_gen,
+        'gen_q': _stage_gen_q,
+        'gen_prefix': _stage_gen_prefix,
+    }
+    watchdog = None
+    watchdog_s = float(os.environ.get('DISTLLM_BENCH_WATCHDOG_S', '300') or 0)
+    if stage in GEN_STAGES and watchdog_s > 0:
+        watchdog = StallWatchdog(
+            watchdog_s, bundle_dir=bundle_dir, name=f'bench-{stage}'
+        ).start()
+    try:
+        fragment = stage_fns[stage]()
+    except BaseException as exc:
+        _dump(f'{stage}: {exc!r}'[:300])
+        raise
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+    print(json.dumps(fragment))
 
 
 def main() -> None:
@@ -654,46 +880,145 @@ def main() -> None:
         try:
             jax.config.update(
                 'jax_compilation_cache_dir',
-                os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             '.jax_cache'),
+                os.path.join(_REPO_DIR, '.jax_cache'),
             )
         except Exception:
             pass
-
-    if args.stage == 'embed':
-        print(json.dumps(_stage_embed()))
-        return
-    if args.stage == 'embed_q':
-        print(json.dumps(_stage_embed_q()))
-        return
-    if args.stage == 'gen':
-        print(json.dumps(_stage_gen()))
-        return
-    if args.stage == 'gen_q':
-        print(json.dumps(_stage_gen_q()))
-        return
-    if args.stage == 'gen_prefix':
-        print(json.dumps(_stage_gen_prefix()))
+        _run_stage_entry(args.stage)
         return
 
-    result: dict = {
+    from distllm_tpu.observability.flight import Deadline, RunRecord
+
+    base: dict = {
         'metric': 'embeddings/sec/chip',
         'value': 0.0,
         'unit': 'emb/s',
         'vs_baseline': 0.0,
     }
-    probe_err = _probe_backend()
-    if probe_err is not None:
-        result['error'] = f'TPU backend unavailable: {probe_err}'
-        print(json.dumps(result))
-        return
+    # Setup itself can fail (unwritable record dir, non-numeric deadline
+    # env, full disk) — before the signal handlers and the emit-protected
+    # try/finally exist. Even then the driver must get a parseable line.
+    try:
+        deadline = Deadline(
+            float(
+                os.environ.get('DISTLLM_BENCH_DEADLINE_S')
+                or DEFAULT_DEADLINE_S
+            ),
+            reserve_s=20.0,
+        )
+        partial_path, snapshot_path = _record_paths()
+        # Each orchestrator run is a fresh record: a stale partial file
+        # from a previous run must not leak its stages into this run's
+        # contract line.
+        for stale in (partial_path, snapshot_path):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        record = RunRecord(partial_path, snapshot_path)
+    except BaseException as exc:
+        base['error'] = f'bench orchestrator setup failed: {exc!r}'[:500]
+        sys.stdout.write(json.dumps(base) + '\n')
+        sys.stdout.flush()
+        raise
 
-    result.update(_run_stage('embed', timeout=1200))
-    result.update(_run_stage('embed_q', timeout=1200))
-    result.update(_run_stage('gen', timeout=2700))
-    result.update(_run_stage('gen_q', timeout=2700))
-    result.update(_run_stage('gen_prefix', timeout=2700))
-    print(json.dumps(result))
+    def _on_signal(signum, frame):
+        # Runs in the main thread, possibly mid-communicate(): touch no
+        # locks the main thread could hold — read the on-disk record,
+        # emit, hard-exit. Exit 0: the line on stdout IS the result.
+        reason = (
+            'deadline_expired' if signum == signal.SIGALRM else 'sigterm'
+        )
+        child = _CURRENT_CHILD.get('proc')
+        if child is not None:
+            try:
+                child.terminate()
+            except Exception:
+                pass
+        _emit_final(
+            record,
+            base,
+            {
+                'interrupted': reason,
+                'deadline_s': deadline.total_s,
+                'elapsed_s': round(deadline.elapsed(), 1),
+            },
+        )
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGALRM, _on_signal)
+    # The alarm is the deadline made unconditional: even a wedged
+    # communicate() or a hung probe gets interrupted in time to emit.
+    signal.alarm(max(1, int(deadline.total_s)))
+
+    # EVERY exit path emits: signals are handled above, and the finally
+    # below covers exceptions (a typo'd stage name, a full disk, a broken
+    # env override) — an orchestrator bug must not re-open the zeroed-
+    # record failure this file exists to close. _emit_final is idempotent.
+    try:
+        record.record(
+            'run',
+            {
+                'bench_deadline_s': deadline.total_s,
+                'bench_started_wall_s': round(time.time(), 1),
+            },
+        )
+        probe_err = _probe_backend(deadline, record)
+        if probe_err is not None:
+            record.record(
+                'probe_failed',
+                {'error': f'TPU backend unavailable: {probe_err}'},
+            )
+            return
+
+        stages_env = os.environ.get('DISTLLM_BENCH_STAGES')
+        stages = (
+            [s.strip() for s in stages_env.split(',') if s.strip()]
+            if stages_env
+            else list(STAGE_ORDER)
+        )
+        # Budget override for smoke tests: a single float applies to every
+        # stage, a JSON object ({"gen": 5}) per stage.
+        override = os.environ.get('DISTLLM_BENCH_STAGE_TIMEOUT_S', '').strip()
+        overrides: dict = (
+            json.loads(override) if override.startswith('{')
+            else dict.fromkeys(NOMINAL_BUDGET_S, float(override)) if override
+            else {}
+        )
+        floor_s = float(os.environ.get('DISTLLM_BENCH_STAGE_FLOOR_S', '60'))
+        outcomes: dict = {}
+        for stage in stages:
+            nominal = float(overrides.get(stage, NOMINAL_BUDGET_S[stage]))
+            budget = deadline.budget(nominal, floor_s=min(floor_s, nominal))
+            if budget <= 0:
+                outcomes[stage] = 'skipped'
+                record.record(
+                    stage,
+                    {
+                        f'{stage}_skipped': (
+                            f'deadline: {deadline.remaining():.0f}s left of '
+                            f'{deadline.total_s:.0f}s'
+                        ),
+                        'bench_stage_outcomes': dict(outcomes),
+                    },
+                )
+                continue
+            fragment, outcome = _run_stage(stage, budget)
+            outcomes[stage] = outcome
+            fragment['bench_stage_outcomes'] = dict(outcomes)
+            record.record(stage, fragment)
+    except BaseException as exc:
+        try:
+            record.record(
+                'orchestrator_error',
+                {'orchestrator_error': repr(exc)[:300]},
+            )
+        except Exception:
+            pass
+        raise
+    finally:
+        _emit_final(record, base, {})
 
 
 if __name__ == '__main__':

@@ -17,9 +17,20 @@ docs/prefix_caching.md, ``distllm_prefix_cache_*`` series at /metrics).
 Observability surface (docs/observability.md):
 
 - ``GET /metrics`` — Prometheus text exposition of the process registry
-  (engine throughput, KV occupancy, queue depth, HTTP latency, ...);
+  (engine throughput, KV occupancy, queue depth, HTTP latency, request
+  TTFT/TPOT/queue-wait, ...);
 - ``GET /health`` — liveness plus uptime / in-flight / served counts;
-- ``GET /debug/traces?limit=N`` — most recent spans from the trace ring.
+- ``GET /debug/traces?limit=N`` — most recent spans from the trace ring;
+- ``GET /debug/flight?limit=N`` — most recent engine flight-recorder
+  records (prefill/decode steps, request lifecycles, preemptions);
+- ``GET /debug/bundle`` — dump a full debug bundle (flight ring + metrics
+  + traces) to disk and return the written paths.
+
+Generation requests run under an optional stall watchdog
+(``DISTLLM_WATCHDOG_S`` seconds, 0 = off): if the engine makes no
+progress for that long mid-request, a debug bundle is dumped
+automatically — the wedge explains itself even if the process is later
+killed.
 
 Run: ``DISTLLM_CHAT_CONFIG=cfg.yaml python -m distllm_tpu.chat_server --port 8000``
 """
@@ -36,11 +47,24 @@ import uuid
 import distllm_tpu
 from distllm_tpu.chat import ChatAppConfig, ChatSession
 from distllm_tpu.observability import (
+    StallWatchdog,
+    dump_debug_bundle,
+    get_flight_recorder,
     get_trace_buffer,
     instruments,
     render_prometheus,
     span,
 )
+
+
+def _debug_dir(kind: str) -> str:
+    """Where on-demand debug bundles land (``DISTLLM_DEBUG_DIR`` or
+    ``./debug_bundles``), one timestamped directory per dump."""
+    base = os.environ.get('DISTLLM_DEBUG_DIR') or os.path.join(
+        os.getcwd(), 'debug_bundles'
+    )
+    stamp = time.strftime('%Y%m%d-%H%M%S')
+    return os.path.join(base, f'{kind}_{stamp}_{os.getpid()}')
 
 
 def _completion_payload(model: str, content: str) -> dict:
@@ -101,8 +125,19 @@ def build_app(config: ChatAppConfig):
                 )
                 scores = results.total_scores[0]
         prompt = template.render(list(messages), contexts, scores)
+        watchdog_s = float(os.environ.get('DISTLLM_WATCHDOG_S', '0') or 0)
         with span('chat-generate'):
-            return session.generator.generate([prompt])[0]
+            if watchdog_s <= 0:
+                return session.generator.generate([prompt])[0]
+            # Armed per request (an idle server is not a stall): if the
+            # engine's flight ring stops advancing mid-generate, dump a
+            # bundle so the wedge explains itself. Never kills the work.
+            with StallWatchdog(
+                watchdog_s,
+                bundle_dir=_debug_dir('watchdog'),
+                name='chat-generate',
+            ):
+                return session.generator.generate([prompt])[0]
 
     async def chat_completions(request: 'web.Request') -> 'web.StreamResponse':
         body = await request.json()
@@ -183,6 +218,35 @@ def build_app(config: ChatAppConfig):
             {'spans': [s.to_dict() for s in spans if s.end_ns is not None]}
         )
 
+    async def flight(request: 'web.Request') -> 'web.Response':
+        try:
+            limit = int(request.query.get('limit', '200'))
+        except ValueError:
+            return web.json_response(
+                {'error': {'message': 'limit must be an integer'}}, status=400
+            )
+        recorder = get_flight_recorder()
+        return web.json_response(
+            {
+                'records': recorder.snapshot(limit=max(1, limit)),
+                'total_recorded': recorder.total_recorded,
+                'capacity': recorder.capacity,
+            }
+        )
+
+    async def bundle(request: 'web.Request') -> 'web.Response':
+        directory = _debug_dir('bundle')
+        # Default thread pool, NOT the single-worker engine executor: the
+        # dump (disk writes + possible device-memory capture) must neither
+        # freeze the event loop nor queue behind a wedged generate — a
+        # wedge is exactly when this endpoint gets called.
+        loop = asyncio.get_running_loop()
+        paths = await loop.run_in_executor(
+            None,
+            lambda: dump_debug_bundle(directory, reason='GET /debug/bundle'),
+        )
+        return web.json_response({'bundle_dir': directory, 'paths': paths})
+
     async def preflight(request: 'web.Request') -> 'web.Response':
         return web.Response(status=204)
 
@@ -217,6 +281,8 @@ def build_app(config: ChatAppConfig):
     app.router.add_get('/health', health)
     app.router.add_get('/metrics', metrics)
     app.router.add_get('/debug/traces', traces)
+    app.router.add_get('/debug/flight', flight)
+    app.router.add_get('/debug/bundle', bundle)
     # Browser preflight for any path (CORS headers added by the middleware).
     app.router.add_route('OPTIONS', '/{tail:.*}', preflight)
     return app

@@ -30,6 +30,7 @@ place in HBM (no per-step cache copies).
 from __future__ import annotations
 
 import itertools
+import time
 from dataclasses import dataclass, field
 from enum import Enum
 
@@ -51,6 +52,7 @@ from distllm_tpu.generate.engine.scheduler import (
 from distllm_tpu.models import mistral
 from distllm_tpu.models.tokenizer import bucket_ladder, pick_bucket
 from distllm_tpu.observability import instruments as _metrics
+from distllm_tpu.observability.flight import get_flight_recorder
 from distllm_tpu.ops.sampling import sample_tokens
 from distllm_tpu.utils import BaseConfig
 
@@ -97,6 +99,14 @@ class Request:
     # last prompt token must be recomputed into a private copy of it
     # (copy-on-write, resolved at prefill dispatch).
     cow_src_block: int | None = None
+    # --- lifecycle timestamps (flight recorder, docs/observability.md) ---
+    # monotonic seconds; 0.0 = not reached. t_admit/t_first_token keep
+    # their FIRST value across recompute preemption: the client-visible
+    # latencies are measured from enqueue, not from the retry.
+    t_enqueue: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_finish: float = 0.0
 
     @property
     def num_tokens(self) -> int:
@@ -168,6 +178,12 @@ class EngineConfig(BaseConfig):
     # monopolize the chip in a single monolithic dispatch. 0 disables
     # chunking.
     prefill_chunk_tokens: int = 0
+    # TTFT service-level objective in seconds (0 = no SLO accounting).
+    # When set, every finished request counts into
+    # distllm_request_slo_total{outcome=met|missed} and met requests'
+    # output tokens into distllm_engine_goodput_tokens_total — goodput,
+    # the throughput a latency-bound deployment actually delivered.
+    ttft_slo_s: float = 0.0
     # Decode windows in flight during generate_ids (2 hides the
     # host<->device round trip behind the next window's compute).
     pipeline_depth: int = 2
@@ -273,6 +289,11 @@ class LLMEngine:
         from collections import Counter
 
         self._stats: 'Counter[str]' = Counter()
+        # Flight recorder: one bounded ring record per prefill dispatch /
+        # decode window / finished request. The process-wide ring also
+        # feeds the StallWatchdog's default progress signal, so a wedged
+        # engine is detectable without any extra wiring.
+        self.flight = get_flight_recorder()
 
         model = self.model_cfg
 
@@ -710,6 +731,7 @@ class LLMEngine:
             request_id=next(self._next_id),
             prompt_ids=list(prompt_ids),
             params=params or SamplingParams(),
+            t_enqueue=time.monotonic(),
         )
         cached_blocks: list[int] = []
         if self.prefix_cache is not None:
@@ -766,6 +788,11 @@ class LLMEngine:
             while (rid := self._admit_next_evicting()) is not None:
                 request = self._requests[rid]
                 request.state = RequestState.RUNNING
+                if request.t_admit == 0.0:  # first admission only, not
+                    request.t_admit = time.monotonic()  # preemption retries
+                    _metrics.REQUEST_QUEUE_WAIT.observe(
+                        request.t_admit - request.t_enqueue
+                    )
                 admitted.append(request)
             if not admitted:
                 return emitted
@@ -881,6 +908,7 @@ class LLMEngine:
         sampled token is discarded.
         """
         _metrics.ENGINE_PREFILL_BATCH.observe(len(requests))
+        t_start = time.monotonic()
         b = 1
         while b < len(requests):
             b *= 2
@@ -920,7 +948,12 @@ class LLMEngine:
         # finishes inside _emit_prefill, after which its row is gone).
         for request in requests:
             self._insert_prompt_blocks(request)
-        return self._emit_prefill(requests, last_logits, b, defer_to)
+        emitted = self._emit_prefill(requests, last_logits, b, defer_to)
+        self._record_step(
+            'prefill', t_start, batch=len(requests),
+            tokens=int(lengths.sum()),
+        )
+        return emitted
 
     def _emit_prefill(
         self,
@@ -1066,6 +1099,7 @@ class LLMEngine:
         _metrics.ENGINE_PREFILL_BATCH.observe(len(requests))
         self._stats['prefill_dispatches'] += 1
         _metrics.ENGINE_PREFILL_DISPATCHES.inc()
+        t_start = time.monotonic()
         b = 1
         while b < len(spans):
             b *= 2
@@ -1105,11 +1139,19 @@ class LLMEngine:
             context_lens_dev,
             tail_lens_dev,
         )
+        chunk_tokens = int(tail_lens.sum())
         if not sample:
+            self._record_step(
+                'prefill', t_start, batch=len(requests), tokens=chunk_tokens
+            )
             return []
         for request in requests:
             self._insert_prompt_blocks(request)
-        return self._emit_prefill(requests, last_logits, b, defer_to)
+        emitted = self._emit_prefill(requests, last_logits, b, defer_to)
+        self._record_step(
+            'prefill', t_start, batch=len(requests), tokens=chunk_tokens
+        )
+        return emitted
 
     def _resolve_cow(self, requests: list[Request]) -> None:
         """Copy-on-write for aligned full-cover hits: duplicate each
@@ -1151,6 +1193,32 @@ class LLMEngine:
         if lent > nb:
             self.sched.lend_prefix(rid, lent)
             request.num_borrowed_blocks = lent
+
+    def _record_step(self, kind: str, t_start: float, *, batch: int,
+                     tokens: int) -> None:
+        """One flight-ring record + metrics pair per engine step.
+
+        ``duration_s`` for prefill is the host-side dispatch (+ sync
+        emission on the synchronous path); for decode it spans dispatch →
+        host fetch, so pipelined in-flight time is included — the wall
+        clock a stalled window would actually burn.
+        """
+        duration_s = time.monotonic() - t_start
+        _metrics.ENGINE_STEPS.labels(kind=kind).inc()
+        _metrics.ENGINE_STEP_SECONDS.labels(kind=kind).observe(duration_s)
+        usable = self.config.num_blocks - 1  # block 0 is reserved
+        self.flight.record(
+            kind,
+            duration_s=round(duration_s, 6),
+            batch=batch,
+            occupancy=round(batch / self.config.max_num_seqs, 4),
+            tokens=tokens,
+            queue_depth=self.sched.num_waiting,
+            running=self.sched.num_running,
+            kv_occupancy=round(
+                (usable - self.sched.num_free_blocks) / usable, 4
+            ) if usable > 0 else 0.0,
+        )
 
     def _block_row(self, rid: int) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -1335,7 +1403,12 @@ class LLMEngine:
         _metrics.ENGINE_DECODE_UTILIZATION.observe(
             sum(1 for _, _, steps in plan if steps > 0) / b
         )
-        return {'tokens': tokens, 'plan': plan, 'last_ids': last_ids}
+        return {
+            'tokens': tokens,
+            'plan': plan,
+            'last_ids': last_ids,
+            't_dispatch': time.monotonic(),
+        }
 
     def _on_preempt(self, request: Request) -> None:
         request.state = RequestState.WAITING
@@ -1354,6 +1427,13 @@ class LLMEngine:
         hidden dispatch latency)."""
         tokens = np.asarray(window['tokens'])  # [K, B]
         emitted: list[tuple[int, int]] = []
+        if 't_dispatch' in window:  # prefill fetch records carry no clock
+            self._record_step(
+                'decode',
+                window['t_dispatch'],
+                batch=sum(1 for _, _, s in window['plan'] if s > 0),
+                tokens=sum(s for _, _, s in window['plan']),
+            )
         for slot, rid, steps in window['plan']:
             if rid in self._unacked:
                 self._unacked[rid] = max(0, self._unacked[rid] - steps)
@@ -1458,6 +1538,13 @@ class LLMEngine:
     def _emit_token(self, request: Request, token: int) -> None:
         # Note: the emitted token is NOT yet written to the KV cache; it is
         # fed as input on the next decode step, which writes it then.
+        if not request.output_ids and request.t_first_token == 0.0:
+            # TTFT is measured to the HOST fetch of the first token — the
+            # latency a streaming client sees, including any pipelined lag.
+            request.t_first_token = time.monotonic()
+            _metrics.REQUEST_TTFT.observe(
+                request.t_first_token - request.t_enqueue
+            )
         request.output_ids.append(token)
         self.sched.append_token(request.request_id)
         _metrics.ENGINE_GENERATED_TOKENS.inc()
@@ -1474,6 +1561,8 @@ class LLMEngine:
 
     def _finish(self, request: Request) -> None:
         request.state = RequestState.FINISHED
+        request.t_finish = time.monotonic()
+        self._observe_lifecycle(request)
         _metrics.ENGINE_REQUESTS_FINISHED.inc()
         self.sched.finish(request.request_id)
         if self.prefix_cache is not None:
@@ -1484,6 +1573,42 @@ class LLMEngine:
         self._unacked.pop(request.request_id, None)
         del self._requests[request.request_id]
         self._finished[request.request_id] = request
+
+    def _observe_lifecycle(self, request: Request) -> None:
+        """Fold one finished request into the lifecycle series and the
+        flight ring: TTFT / TPOT histograms, SLO + goodput counters when an
+        SLO is configured, and one ``'request'`` flight record carrying the
+        whole enqueue→admit→first-token→finish timeline."""
+        n_out = len(request.output_ids)
+        ttft_s = (
+            request.t_first_token - request.t_enqueue
+            if request.t_first_token else None
+        )
+        tpot_s = None
+        if request.t_first_token and n_out > 1:
+            tpot_s = (request.t_finish - request.t_first_token) / (n_out - 1)
+            _metrics.REQUEST_TPOT.observe(tpot_s)
+        slo = self.config.ttft_slo_s
+        if slo > 0 and ttft_s is not None:
+            met = ttft_s <= slo
+            _metrics.REQUEST_SLO.labels(
+                outcome='met' if met else 'missed'
+            ).inc()
+            self._stats['slo_met' if met else 'slo_missed'] += 1
+            if met:
+                _metrics.GOODPUT_TOKENS.inc(n_out)
+                self._stats['goodput_tokens'] += n_out
+        self.flight.record(
+            'request',
+            request_id=request.request_id,
+            prompt_tokens=len(request.prompt_ids),
+            output_tokens=n_out,
+            queue_wait_s=round(request.t_admit - request.t_enqueue, 6)
+            if request.t_admit else None,
+            ttft_s=round(ttft_s, 6) if ttft_s is not None else None,
+            tpot_s=round(tpot_s, 6) if tpot_s is not None else None,
+            cached_tokens=request.num_cached_tokens,
+        )
 
     # -------------------------------------------------------------- offline
     def generate_ids(

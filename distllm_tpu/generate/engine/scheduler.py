@@ -469,9 +469,15 @@ class InstrumentedScheduler:
 
     def __init__(self, inner: Scheduler, num_blocks: int) -> None:
         from distllm_tpu.observability import instruments
+        from distllm_tpu.observability.flight import get_flight_recorder
 
         self._inner = inner
         self._m = instruments
+        # Preemptions and pool exhaustion are the scheduler events worth a
+        # flight-ring entry: rare, and exactly what a post-mortem needs.
+        # Admission defers are counters only — they fire every loop under
+        # load and would evict useful ring history.
+        self._flight = get_flight_recorder()
         self._usable_blocks = num_blocks - 1  # block 0 is reserved
         self._m.KV_BLOCKS_TOTAL.set(self._usable_blocks)
         self._sync()
@@ -509,9 +515,25 @@ class InstrumentedScheduler:
             if exc.preempted:
                 self._m.SCHED_PREEMPTIONS.inc(len(exc.preempted))
             self._sync()
+            self._flight.record(
+                'event',
+                event='scheduler_exhausted',
+                error=str(exc)[:300],
+                preempted=list(exc.preempted),
+                free_blocks=self._inner.num_free_blocks,
+                queue_depth=self._inner.num_waiting,
+            )
             raise
         if preempted:
             self._m.SCHED_PREEMPTIONS.inc(len(preempted))
+            self._flight.record(
+                'preempt',
+                rids=list(preempted),
+                k=k,
+                free_blocks=self._inner.num_free_blocks,
+                running=self._inner.num_running,
+                queue_depth=self._inner.num_waiting,
+            )
         self._sync()
         return preempted
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import hashlib
 import json
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor, as_completed
@@ -25,6 +26,7 @@ from distllm_tpu.mcqa.batching import BatchingClient
 from distllm_tpu.mcqa.checkpoint import CheckpointManager
 from distllm_tpu.mcqa.config import MCQAConfig
 from distllm_tpu.mcqa.grading import grade_answer
+from distllm_tpu.observability.flight import StallWatchdog
 from distllm_tpu.observability.instruments import log_event
 
 
@@ -308,6 +310,20 @@ def run_mcqa(config: MCQAConfig) -> dict[str, Any]:
         progress.update(1)
 
     errors: list[tuple[int, str]] = []
+    # Stall watchdog over question completions: a wedged model server or a
+    # deadlocked batcher shows up as zero progress, and the dumped bundle
+    # (flight ring + metrics + traces in output_dir/debug_bundle) explains
+    # the wedge even if the run is later killed. DISTLLM_MCQA_WATCHDOG_S=0
+    # disables; the dog never kills the run itself.
+    watchdog_s = float(os.environ.get('DISTLLM_MCQA_WATCHDOG_S', '900') or 0)
+    watchdog = None
+    if todo and watchdog_s > 0:
+        watchdog = StallWatchdog(
+            watchdog_s,
+            progress_fn=lambda: len(checkpoints.completed_indices),
+            bundle_dir=config.output_dir / 'debug_bundle',
+            name='mcqa',
+        ).start()
     try:
         with ThreadPoolExecutor(max_workers=config.parallel_workers) as pool:
             futures = {pool.submit(process_question, i): i for i in todo}
@@ -318,6 +334,8 @@ def run_mcqa(config: MCQAConfig) -> dict[str, Any]:
                 except Exception as exc:  # noqa: BLE001 - recorded + reported
                     errors.append((index, repr(exc)))
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         progress.close()
         batcher.close()
         if server is not None:

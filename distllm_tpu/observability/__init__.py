@@ -9,7 +9,10 @@ Three layers, all dependency-free:
   this: every timer emits both the legacy ``[timer]`` line and a span);
 - :mod:`~distllm_tpu.observability.instruments` — the catalog of well-known
   series (engine, KV cache, scheduler, HTTP, fabric workers) plus the
-  ``log_event`` stdout funnel.
+  ``log_event`` stdout funnel;
+- :mod:`~distllm_tpu.observability.flight` — the flight-recorder layer
+  (ISSUE 3 tentpole): bounded per-engine-step ring, stall watchdog, debug
+  bundles, crash-proof ``RunRecord`` + ``Deadline`` for the bench contract.
 
 ``aggregate`` (imported lazily to avoid a cycle with ``timer``) rolls
 multi-host ``[timer]`` logs into one stats table. Metric names and
@@ -18,6 +21,14 @@ conventions are documented in ``docs/observability.md``.
 
 from __future__ import annotations
 
+from distllm_tpu.observability.flight import (
+    Deadline,
+    FlightRecorder,
+    RunRecord,
+    StallWatchdog,
+    dump_debug_bundle,
+    get_flight_recorder,
+)
 from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.observability.metrics import (
     Counter,
@@ -40,14 +51,20 @@ from distllm_tpu.observability.tracing import (
 
 __all__ = [
     'Counter',
+    'Deadline',
+    'FlightRecorder',
     'Gauge',
     'Histogram',
     'MetricsRegistry',
+    'RunRecord',
     'Span',
+    'StallWatchdog',
     'TraceBuffer',
     'begin_span',
+    'dump_debug_bundle',
     'dump_traces',
     'end_span',
+    'get_flight_recorder',
     'get_registry',
     'get_trace_buffer',
     'log_buckets',

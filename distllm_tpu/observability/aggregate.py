@@ -1,41 +1,99 @@
 """Roll up multi-host worker logs into one stats table.
 
 Fabric workers on every TPU host emit ``[timer]`` lines (see ``timer.py``)
-into their own stdout/log files. This module merges any number of those
-captures into a single ``{tags: TimeStats}`` view — the multi-host
-aggregation the reference could only do by hand — and renders it as a
-fixed-width table whose columns (count / total / mean / p50 / p95 / max)
-match what ``distllm_stage_duration_seconds`` exposes over ``/metrics``.
+into their own stdout/log files, and every process can dump its span ring
+as JSONL (``observability.dump_traces``, the bench debug bundles'
+``traces.jsonl``/``flight.jsonl``). This module merges any number of those
+captures — both formats, freely mixed — into a single ``{tags: TimeStats}``
+view, the multi-host aggregation the reference could only do by hand, and
+renders it as a fixed-width table whose columns (count / total / mean /
+p50 / p95 / max) match what ``distllm_stage_duration_seconds`` exposes
+over ``/metrics``.
 
 CLI::
 
-    python -m distllm_tpu.observability.aggregate run/logs/*.txt
+    python -m distllm_tpu.observability.aggregate run/logs/*.txt \\
+        run/bundles/*/traces.jsonl
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 from pathlib import Path
 
 
+def _merge_span_lines(capture: str, add) -> None:
+    """Fold span-JSONL records (``TraceBuffer.dump_jsonl`` format) and
+    timed flight-ring records (``FlightRecorder.dump_jsonl``) into the
+    aggregation via ``add(tags, elapsed_s, start_ns, end_ns)``. A record
+    keys by its ``tags`` tuple (falling back to ``(name,)`` / ``(kind,)``)
+    so Timer-shim spans merge with their own ``[timer]`` lines; JSON lines
+    without a duration and torn lines are skipped."""
+    for line in capture.splitlines():
+        line = line.strip()
+        if not line.startswith('{'):
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn final line from a killed process
+        if not isinstance(record, dict):
+            continue
+        name = record.get('name') or record.get('kind')
+        duration = record.get('duration_s')
+        if name is None or duration is None:
+            continue
+        tags = tuple(record.get('tags') or ()) or (str(name),)
+        add(
+            tags,
+            float(duration),
+            int(record.get('start_ns') or 0),
+            int(record.get('end_ns') or 0),
+        )
+
+
 def aggregate_lines(captures: list[str]) -> dict[tuple[str, ...], object]:
-    """Merge multiple log captures (strings) into one stats dict."""
+    """Merge multiple log captures (strings) into one stats dict.
+
+    Each capture may hold ``[timer]`` lines, span-JSONL records, or both.
+    ``timer.Timer`` emits BOTH formats for every timed region, so the same
+    measurement commonly arrives twice (stdout log + trace dump of the
+    same process); measurements with real clock bounds are deduplicated on
+    ``(tags, start_ns, end_ns)`` across all captures and formats.
+    Zero/absent bounds (hand-written lines, flight records) are exempt —
+    distinct measurements there would otherwise collapse.
+    """
     # Lazy import: timer.py imports this package at module load.
     from distllm_tpu.timer import TimeLogger, TimeStats
 
     logger = TimeLogger()
     merged: dict[tuple[str, ...], TimeStats] = {}
+    seen: set[tuple] = set()
+
+    def add(tags, elapsed_s, start_ns, end_ns):
+        if start_ns and end_ns:
+            key = (tags, start_ns, end_ns)
+            if key in seen:
+                return
+            seen.add(key)
+        entry = merged.setdefault(tags, TimeStats(tags=tags))
+        entry.elapsed_s.append(elapsed_s)
+        entry.start_ns.append(start_ns)
+        entry.end_ns.append(end_ns)
+
     for capture in captures:
         for tags, stats in logger.parse_lines(capture).items():
-            entry = merged.setdefault(tags, TimeStats(tags=tags))
-            entry.elapsed_s.extend(stats.elapsed_s)
-            entry.start_ns.extend(stats.start_ns)
-            entry.end_ns.extend(stats.end_ns)
+            for elapsed, start, end in zip(
+                stats.elapsed_s, stats.start_ns, stats.end_ns
+            ):
+                add(tags, elapsed, start, end)
+        _merge_span_lines(capture, add)
     return merged
 
 
 def aggregate_logs(paths: list[str | Path]) -> dict[tuple[str, ...], object]:
-    """Merge ``[timer]`` lines from many log files into one stats dict."""
+    """Merge ``[timer]`` lines and span-JSONL dumps from many files."""
     return aggregate_lines([Path(p).read_text() for p in paths])
 
 

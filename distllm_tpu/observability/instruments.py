@@ -115,6 +115,65 @@ ENGINE_PREFILL_CHUNK_TOKENS = _registry.histogram(
     buckets=(16, 32, 64, 128, 256, 512, 1024, 2048),
 )
 
+# ------------------------------------------------- request lifecycle (SLO)
+REQUEST_TTFT = _registry.histogram(
+    'distllm_request_ttft_seconds',
+    'Time to first token: add_request -> first generated token fetched on '
+    'the host (the latency a streaming client sees).',
+)
+REQUEST_TPOT = _registry.histogram(
+    'distllm_request_tpot_seconds',
+    'Time per output token after the first (decode steady-state), '
+    'per finished request: (finish - first_token) / (output_tokens - 1).',
+    buckets=log_buckets(1e-4, 10.0),
+)
+REQUEST_QUEUE_WAIT = _registry.histogram(
+    'distllm_request_queue_wait_seconds',
+    'Admission queue wait: add_request -> decode-slot admission.',
+)
+REQUEST_SLO = _registry.counter(
+    'distllm_request_slo_total',
+    'Finished requests vs the TTFT SLO (EngineConfig.ttft_slo_s), by '
+    'outcome met/missed. Only counted when an SLO is configured.',
+    labelnames=('outcome',),
+)
+GOODPUT_TOKENS = _registry.counter(
+    'distllm_engine_goodput_tokens_total',
+    'Output tokens from requests that met the TTFT SLO — goodput, the '
+    'throughput that actually counted.',
+)
+ENGINE_STEPS = _registry.counter(
+    'distllm_engine_steps_total',
+    'Engine steps recorded by the flight recorder, by kind '
+    '(prefill/decode).',
+    labelnames=('kind',),
+)
+ENGINE_STEP_SECONDS = _registry.histogram(
+    'distllm_engine_step_duration_seconds',
+    'Wall time per engine step, by kind: prefill = host-side dispatch of '
+    'one padded prefill; decode = dispatch -> host fetch of one fused '
+    'window (includes pipelined in-flight time).',
+    labelnames=('kind',),
+)
+
+# Pre-create the fixed label sets so the full request-lifecycle schema is
+# present in the very first scrape, before any traffic.
+for _kind in ('prefill', 'decode'):
+    ENGINE_STEPS.labels(kind=_kind)
+    ENGINE_STEP_SECONDS.labels(kind=_kind)
+for _outcome in ('met', 'missed'):
+    REQUEST_SLO.labels(outcome=_outcome)
+
+# -------------------------------------------------- watchdog / debug bundle
+WATCHDOG_STALLS = _registry.counter(
+    'distllm_watchdog_stalls_total',
+    'StallWatchdog firings (no observed progress for the stall window).',
+)
+DEBUG_BUNDLES = _registry.counter(
+    'distllm_debug_bundles_total',
+    'Debug bundles dumped (watchdog stalls, stage failures, /debug/bundle).',
+)
+
 # ------------------------------------------------------------ scheduler
 SCHED_QUEUE_DEPTH = _registry.gauge(
     'distllm_scheduler_queue_depth',

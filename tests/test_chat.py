@@ -242,3 +242,39 @@ def test_chat_server_traces_endpoint(chat_server_client):
         assert span['status'] in ('ok', 'error')
         assert span['duration_s'] >= 0
     assert requests.get(f'{base}/debug/traces?limit=x').status_code == 400
+
+
+def test_chat_server_flight_endpoint(chat_server_client):
+    import requests
+
+    from distllm_tpu.observability import get_flight_recorder
+
+    base = chat_server_client
+    get_flight_recorder().record(
+        'decode', duration_s=0.01, batch=2, queue_depth=0
+    )
+    body = requests.get(f'{base}/debug/flight?limit=50').json()
+    assert body['total_recorded'] >= 1
+    assert body['capacity'] >= 1
+    kinds = [r['kind'] for r in body['records']]
+    assert 'decode' in kinds
+    for record in body['records']:
+        assert 't_wall' in record
+    assert requests.get(f'{base}/debug/flight?limit=x').status_code == 400
+
+
+def test_chat_server_bundle_endpoint(chat_server_client, tmp_path, monkeypatch):
+    import requests
+
+    monkeypatch.setenv('DISTLLM_DEBUG_DIR', str(tmp_path))
+    base = chat_server_client
+    body = requests.get(f'{base}/debug/bundle').json()
+    assert body['bundle_dir'].startswith(str(tmp_path))
+    paths = body['paths']
+    assert set(paths) >= {'flight', 'metrics', 'traces', 'meta'}
+    from pathlib import Path
+
+    assert Path(paths['meta']).exists()
+    assert 'distllm_engine_generated_tokens_total' in Path(
+        paths['metrics']
+    ).read_text()

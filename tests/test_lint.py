@@ -150,6 +150,73 @@ def test_no_direct_block_free_outside_allocator_modules():
     )
 
 
+def _catalog_registered_names() -> set[str]:
+    """Metric names registered in the instruments.py catalog: the first
+    string argument of every ``*.counter/gauge/histogram(...)`` call."""
+    tree = ast.parse(
+        (REPO / 'distllm_tpu' / 'observability' / 'instruments.py').read_text()
+    )
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ('counter', 'gauge', 'histogram')
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            names.add(node.args[0].value)
+    return names
+
+
+def test_metric_names_registered_in_catalog():
+    """Every ``distllm_*`` metric name referenced anywhere in the package
+    (string literals — code AND docstrings) must be registered in the
+    ``instruments.py`` catalog. Prevents silent series drift: a typo'd or
+    ad-hoc ``registry.counter('distllm_...')`` at a call site would create
+    a series the catalog (and docs/observability.md, and the
+    first-scrape-full-schema guarantee) knows nothing about.
+
+    Histogram references may use the exposition suffixes ``_bucket`` /
+    ``_sum`` / ``_count`` of a registered base name.
+    """
+    import re
+
+    registered = _catalog_registered_names()
+    assert registered, 'catalog parse came back empty — rule is broken'
+    # Full-literal matches only; 'distllm_tpu*' is the package itself, and
+    # globs like 'distllm_prefix_cache_*' never match the name regex.
+    name_re = re.compile(r'^distllm_[a-z0-9_]+$')
+    suffix_re = re.compile(r'_(bucket|sum|count)$')
+    offenders = []
+    for path in sorted((REPO / 'distllm_tpu').rglob('*.py')):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+            ):
+                continue
+            for word in re.findall(r'[A-Za-z0-9_]+', node.value):
+                if (
+                    not name_re.match(word)
+                    or word.startswith('distllm_tpu')
+                    or word.endswith('_')  # doc glob like distllm_foo_*
+                ):
+                    continue
+                base = suffix_re.sub('', word)
+                if word not in registered and base not in registered:
+                    offenders.append(
+                        f'{path.relative_to(REPO)}:{node.lineno} {word}'
+                    )
+    assert not offenders, (
+        'distllm_* metric names not registered in the instruments.py '
+        'catalog (add them there — the catalog is the series contract):\n'
+        + '\n'.join(sorted(set(offenders)))
+    )
+
+
 @pytest.mark.skipif(shutil.which('ruff') is None, reason='ruff not installed')
 def test_ruff():
     proc = subprocess.run(

@@ -1,17 +1,23 @@
 """Metrics registry, tracing, Timer shim, aggregation, scheduler wiring
-(ISSUE 1 tentpole + satellites)."""
+(ISSUE 1 tentpole + satellites) and the flight-recorder layer (ISSUE 3)."""
 
 from __future__ import annotations
 
 import json
 import math
 import re
+import time
 
 import pytest
 
 from distllm_tpu.observability import (
+    Deadline,
+    FlightRecorder,
     MetricsRegistry,
+    RunRecord,
+    StallWatchdog,
     TraceBuffer,
+    dump_debug_bundle,
     get_registry,
     get_trace_buffer,
     log_buckets,
@@ -252,6 +258,226 @@ def test_aggregate_multi_host_logs(tmp_path):
     assert lines[2].startswith('embed,f1')  # sorted by total desc
 
     assert aggregate_lines([]) == {}
+
+
+def test_aggregate_merges_span_jsonl_with_timer_lines(tmp_path):
+    # A [timer] log from one host...
+    timer_log = tmp_path / 'host-a.log'
+    timer_log.write_text(_fake_log('embed,f1', [1.0]))
+    # ...and a span-JSONL dump from another (Timer-shim spans carry the
+    # same tags, so both formats merge into ONE stats row).
+    buffer = TraceBuffer()
+    with span('embed', 'embed', 'f1', buffer=buffer):
+        pass
+    with span('solo-span', buffer=buffer):
+        pass
+    span_dump = tmp_path / 'host-b-traces.jsonl'
+    buffer.dump_jsonl(span_dump)
+    # Flight-ring dumps merge too (keyed by record kind)...
+    flight = FlightRecorder()
+    flight.record('decode', duration_s=0.25)
+    flight.record('decode', duration_s=0.35)
+    flight.record('request', ttft_s=0.1)  # no duration_s -> skipped
+    flight_dump = tmp_path / 'host-b-flight.jsonl'
+    flight.dump_jsonl(flight_dump)
+    # ...and torn lines (killed process mid-write) are skipped.
+    with open(span_dump, 'a') as handle:
+        handle.write('{"name": "torn", "duration_s"')
+
+    merged = aggregate_logs([timer_log, span_dump, flight_dump])
+    assert merged[('embed', 'f1')].count == 2  # timer line + span record
+    assert merged[('solo-span',)].count == 1
+    assert merged[('decode',)].count == 2
+    assert merged[('decode',)].total_s == pytest.approx(0.6)
+    assert ('torn',) not in merged
+
+
+def test_aggregate_dedups_same_measurement_across_formats(tmp_path, capsys):
+    """timer.Timer emits BOTH a [timer] line and a span for every timed
+    region; passing a worker's stdout log AND its trace dump must not
+    double count the measurement (same tags + same clock bounds)."""
+    buffer = get_trace_buffer()
+    with Timer('dedup-stage', 'f7'):
+        pass
+    timer_log = tmp_path / 'worker.log'
+    timer_log.write_text(capsys.readouterr().out)
+    span_dump = tmp_path / 'traces.jsonl'
+    recorded = buffer.snapshot()[-1]
+    span_dump.write_text(json.dumps(recorded.to_dict()) + '\n')
+
+    merged = aggregate_logs([timer_log, span_dump])
+    assert merged[('dedup-stage', 'f7')].count == 1
+
+
+def test_aggregate_cli_entry_point(tmp_path, capsys):
+    from distllm_tpu.observability.aggregate import main
+
+    log = tmp_path / 'worker.log'
+    log.write_text(_fake_log('cli-stage', [1.0, 3.0]))
+    assert main([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert 'cli-stage' in out and 'p95_s' in out
+    # No parseable telemetry in the inputs -> nonzero exit.
+    empty = tmp_path / 'empty.log'
+    empty.write_text('nothing here\n')
+    assert main([str(empty)]) == 1
+
+
+def test_aggregate_runs_as_module(tmp_path):
+    """``python -m distllm_tpu.observability.aggregate`` is the operator
+    CLI — keep the module executable."""
+    import subprocess
+    import sys
+
+    log = tmp_path / 'worker.log'
+    log.write_text(_fake_log('mod-stage', [2.0]))
+    proc = subprocess.run(
+        [
+            sys.executable, '-m', 'distllm_tpu.observability.aggregate',
+            str(log),
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert 'mod-stage' in proc.stdout
+
+
+# ------------------------------------------------------- flight recorder
+def test_flight_recorder_ring_and_dump(tmp_path):
+    recorder = FlightRecorder(capacity=3)
+    for i in range(5):
+        recorder.record('decode', step=i, duration_s=0.01)
+    assert len(recorder) == 3
+    assert recorder.total_recorded == 5
+    steps = [r['step'] for r in recorder.snapshot()]
+    assert steps == [2, 3, 4]
+    assert [r['step'] for r in recorder.snapshot(limit=2)] == [3, 4]
+    assert all(r['kind'] == 'decode' for r in recorder.snapshot())
+    assert all('t_wall' in r for r in recorder.snapshot())
+
+    out = tmp_path / 'flight.jsonl'
+    assert recorder.dump_jsonl(out) == 3
+    records = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r['step'] for r in records] == [2, 3, 4]
+
+
+def test_debug_bundle_contents(tmp_path):
+    recorder = FlightRecorder()
+    recorder.record('prefill', duration_s=0.5, batch=4)
+    with span('bundle-span'):
+        pass
+    paths = dump_debug_bundle(
+        tmp_path / 'bundle', reason='unit test', recorder=recorder,
+        extra={'stage': 'gen'},
+    )
+    assert set(paths) >= {'flight', 'metrics', 'traces', 'meta'}
+    flight = [
+        json.loads(line)
+        for line in (tmp_path / 'bundle' / 'flight.jsonl').read_text().splitlines()
+    ]
+    assert flight[0]['kind'] == 'prefill'
+    assert 'distllm_engine_steps_total' in (
+        tmp_path / 'bundle' / 'metrics.prom'
+    ).read_text()
+    meta = json.loads((tmp_path / 'bundle' / 'meta.json').read_text())
+    assert meta['reason'] == 'unit test'
+    assert meta['stage'] == 'gen'
+
+
+def test_stall_watchdog_fires_on_stall_and_respects_progress():
+    recorder = FlightRecorder()
+    fired = []
+    dog = StallWatchdog(
+        0.2,
+        progress_fn=lambda: recorder.total_recorded,
+        on_stall=fired.append,
+        poll_s=0.05,
+    )
+    with dog:
+        # Keep making progress: the dog must stay quiet.
+        for _ in range(4):
+            recorder.record('decode')
+            time.sleep(0.08)
+        assert fired == []
+        # Stop progressing: the dog fires exactly once (max_fires=1).
+        time.sleep(0.6)
+    assert len(fired) == 1
+    assert dog.fired == 1
+
+
+def test_stall_watchdog_beat_counts_as_progress():
+    fired = []
+    dog = StallWatchdog(
+        0.2, progress_fn=lambda: 0, on_stall=fired.append, poll_s=0.05
+    )
+    with dog:
+        for _ in range(4):
+            dog.beat()
+            time.sleep(0.08)
+        assert fired == []
+
+
+def test_stall_watchdog_default_dumps_bundle(tmp_path):
+    recorder_value = [0]
+    dog = StallWatchdog(
+        0.15,
+        progress_fn=lambda: recorder_value[0],
+        bundle_dir=tmp_path / 'stall',
+        poll_s=0.05,
+        name='unit-dog',
+    )
+    from distllm_tpu.observability import instruments
+
+    stalls_before = instruments.WATCHDOG_STALLS.value
+    with dog:
+        time.sleep(0.5)
+    assert (tmp_path / 'stall' / 'meta.json').exists()
+    assert instruments.WATCHDOG_STALLS.value == stalls_before + 1
+
+
+# ------------------------------------------------------------- run record
+def test_run_record_incremental_and_snapshot(tmp_path):
+    record = RunRecord(tmp_path / 'BENCH_partial.jsonl')
+    record.record('embed', {'metric': 'emb/s', 'value': 100.0})
+    # The JSONL line is durable immediately (fsync'd append).
+    lines = (tmp_path / 'BENCH_partial.jsonl').read_text().splitlines()
+    assert len(lines) == 1
+    record.record('gen', {'gen_value': 800.0})
+    assert record.stages() == ['embed', 'gen']
+    composed = record.compose()
+    assert composed == {'metric': 'emb/s', 'value': 100.0, 'gen_value': 800.0}
+    # Snapshot is the composed view, rewritten atomically per record().
+    snapshot = json.loads(record.snapshot_path.read_text())
+    assert snapshot == composed
+    # A fresh reader (crash recovery) replays the same state from disk.
+    replay = RunRecord(tmp_path / 'BENCH_partial.jsonl')
+    assert replay.compose() == composed
+
+
+def test_run_record_skips_torn_final_line(tmp_path):
+    record = RunRecord(tmp_path / 'rec.jsonl')
+    record.record('embed', {'value': 1.0})
+    with open(record.path, 'a') as handle:
+        handle.write('{"stage": "gen", "fragment": {"gen_va')  # torn write
+    assert record.stages() == ['embed']
+    assert record.compose() == {'value': 1.0}
+
+
+# --------------------------------------------------------------- deadline
+def test_deadline_budgets_and_expiry():
+    deadline = Deadline(100.0, reserve_s=10.0)
+    assert not deadline.expired
+    # Nominal budget clamps to remaining (90s window left).
+    assert deadline.budget(3600.0) <= 90.0
+    assert deadline.budget(5.0) == 5.0
+    # Below the floor: skip signal.
+    assert deadline.budget(3600.0, floor_s=1000.0) == 0.0
+    tiny = Deadline(0.05, reserve_s=0.0)
+    time.sleep(0.1)
+    assert tiny.expired
+    assert tiny.budget(10.0) == 0.0
+    with pytest.raises(ValueError):
+        Deadline(0)
 
 
 # ---------------------------------------------------------------- log_event
