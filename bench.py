@@ -31,9 +31,10 @@ record and a global wall-clock deadline:
 - SIGTERM / SIGALRM / normal exit all emit the SAME driver-contract line,
   composed from whatever the run record holds — so an external kill still
   publishes every completed stage;
-- stages run cheapest-first (embed → embed_q → gen → gen_prefix → gen_q:
-  embed warmups are minutes, ``gen_prefix`` reuses ``gen``'s compile cache,
-  and int8 ``gen_q``'s cold warmup — 22–45 min in round 4 — goes last);
+- stages run cheapest-first (embed → embed_q → gen → gen_prefix →
+  gen_mixed → gen_q: embed warmups are minutes, ``gen_prefix``/
+  ``gen_mixed`` reuse ``gen``'s compile cache, and int8 ``gen_q``'s cold
+  warmup — 22–45 min in round 4 — goes last);
 - a failing or SIGTERM'd stage dumps a debug bundle (flight ring, metrics,
   traces — ``observability.dump_debug_bundle``) so a dead stage still
   explains itself, and gen stages run under a ``StallWatchdog``.
@@ -227,6 +228,43 @@ def _stage_embed(quantization: str | None = None, prefix: str = '') -> dict:
     return out
 
 
+def _measure_load_ttft(engine, prompts, probe_prompt, sampling,
+                       probe_sampling) -> float | None:
+    """TTFT of a request injected while the engine is mid-stream at full
+    decode batch (``gen_load_ttft_s``) — the interference number mixed
+    batching exists to improve: standalone prefill dispatches serialize
+    between decode windows (probe_gen, BENCH_NOTES_r05.md), so a request
+    arriving under load pays its prefill AGAINST the running stream.
+
+    Saturates the batch via ``step()``, waits until every slot is
+    actively decoding, injects one probe request, and reads its
+    first-token latency off the request-lifecycle timestamps.
+    """
+    from distllm_tpu.generate.engine.engine import RequestState
+
+    for prompt in prompts:
+        engine.add_request(prompt, sampling)
+    probe_rid = None
+    while engine.has_unfinished:
+        engine.step()
+        if probe_rid is not None:
+            continue
+        running = [
+            r for r in engine._requests.values()
+            if r.state is RequestState.RUNNING
+        ]
+        if len(running) >= min(
+            len(prompts), engine.config.max_num_seqs
+        ) and all(r.output_ids for r in running):
+            probe_rid = engine.add_request(probe_prompt, probe_sampling)
+    if probe_rid is None:
+        return None
+    probe = engine._finished.pop(probe_rid, None)
+    if probe is None or not probe.t_first_token:
+        return None
+    return probe.t_first_token - probe.t_enqueue
+
+
 def _run_gen(quantization: str | None, prefix: str) -> dict:
     """Generation through the continuous-batching engine at Mistral-7B dims
     (random weights on device; numerics irrelevant to throughput).
@@ -270,6 +308,11 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
         # int8: ~7 GiB weights frees HBM for the reference's production
         # batch (max_num_seqs 128).
         max_num_seqs, num_blocks, n_prompts = 128, 2840, 320
+    # A/B toggle for mixed prefill+decode windows (docs/serving.md):
+    # DISTLLM_BENCH_MIXED=1 serves this stage with prefill chunks riding
+    # decode windows; the dedicated gen_mixed stage runs the token-
+    # identity A/B either way.
+    mixed = os.environ.get('DISTLLM_BENCH_MIXED', '') not in ('', '0')
     engine_cfg = EngineConfig(
         block_size=16,
         num_blocks=num_blocks,
@@ -281,6 +324,12 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
         # Serving fast path: top-64 sampling window instead of a 32k-vocab
         # sort per decode step (exact top-p within the window).
         sampling_top_window=64,
+        enable_mixed_batching=mixed,
+        max_window_prefill_tokens=256,
+        # Only paged-route tails ride windows; chunking is what puts this
+        # stage's fresh 32-192-token prompts on that route when the
+        # toggle is on. Off keeps the classic batched dense prefill.
+        prefill_chunk_tokens=64 if mixed else 0,
     )
     rng = np.random.default_rng(0)
     prompts = [
@@ -356,6 +405,22 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
     )
     ttft_s = time.perf_counter() - ttft_start
 
+    # TTFT *under load*: inject a request while the engine is mid-stream
+    # at full decode batch (gen_load_ttft_s, next to gen_ttft_s). This is
+    # the interference number mixed batching must improve — the idle-
+    # engine ttft_s above cannot see prefill/decode serialization.
+    load_ttft_s = _measure_load_ttft(
+        engine,
+        prompts[: min(max_num_seqs, len(prompts))],
+        prompts[-1],
+        SamplingParams(
+            temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=32
+        ),
+        SamplingParams(
+            temperature=0.5, top_p=0.95, min_p=0.1, max_tokens=2
+        ),
+    )
+
     # DISTLLM_BENCH_PROFILE=<dir> wraps the timed region in a profiler
     # trace (XPlane + TensorBoard format): on hardware this shows per-op
     # device time for the decode windows — the ground truth the AOT HLO
@@ -412,6 +477,10 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
         ),
         f'{prefix}warmup_secs': round(warmup_secs, 1),
         f'{prefix}ttft_s': round(ttft_s, 3),
+        f'{prefix}load_ttft_s': (
+            round(load_ttft_s, 3) if load_ttft_s is not None else None
+        ),
+        f'{prefix}mixed_batching': mixed,
         **_cache_fields(prefix, cache_before),
     }
     if quantization:
@@ -568,6 +637,173 @@ def _stage_gen_prefix() -> dict:
     return out
 
 
+def _stage_gen_mixed() -> dict:
+    """Mixed serving-window A/B (docs/serving.md): the SAME staggered
+    serving workload with ``enable_mixed_batching`` off, then on.
+
+    The contract this stage checks and records:
+
+    - greedy output tokens are BIT-IDENTICAL between the arms;
+    - the on arm folds prefill chunks into decode windows (``mixed``
+      flight records present, standalone prefill dispatch count strictly
+      lower than the off arm);
+    - both arms record the mid-stream ``load_ttft`` interference number
+      (the idle-engine TTFT cannot see prefill/decode serialization).
+
+    The workload staggers finish budgets so slots free while neighbours
+    still decode — mid-stream admission is what rides windows; a uniform
+    batch that drains all slots at once never exercises the fold.
+    """
+    import jax
+    import numpy as np
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.models import mistral
+    from distllm_tpu.observability.flight import get_flight_recorder
+
+    prefix = 'gen_mixed_'
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+        )
+        max_num_seqs, num_blocks = 4, 160
+        n_prompts, prompt_lo, prompt_hi = 12, 8, 48
+        budget, chunk, out_lo, out_hi = 16, 16, 4, 24
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+        max_num_seqs, num_blocks = 32, 712
+        n_prompts, prompt_lo, prompt_hi = 64, 32, 192
+        budget, chunk, out_lo, out_hi = 256, 256, 16, 96
+
+    rng = np.random.default_rng(0)
+    # Every third prompt repeats a 2-block shared prefix (the RAG/MCQA
+    # shape): its cached-prefix tail is a paged-route span that rides
+    # windows; the long fresh prompts ride through chunked tails.
+    shared = list(rng.integers(1, model_cfg.vocab_size, size=32))
+    prompts = []
+    for i, n in enumerate(rng.integers(prompt_lo, prompt_hi, size=n_prompts)):
+        tail = list(rng.integers(1, model_cfg.vocab_size, size=int(n)))
+        prompts.append(shared + tail if i % 3 == 0 else tail)
+    budgets = [int(n) for n in rng.integers(out_lo, out_hi, size=n_prompts)]
+    # The load-TTFT probe must be a NEVER-SEEN prompt: by probe time the
+    # main A/B run has adopted every workload prompt's full blocks into
+    # the per-engine prefix cache, and a cached probe would measure a
+    # ~1-token COW admission instead of prefill-under-load interference.
+    probe_prompt = list(
+        rng.integers(1, model_cfg.vocab_size, size=prompt_hi)
+    )
+
+    def run_arm(mixed: bool) -> dict:
+        engine_cfg = EngineConfig(
+            block_size=16,
+            num_blocks=num_blocks,
+            max_num_seqs=max_num_seqs,
+            max_model_len=512,
+            decode_steps=16,
+            pipeline_depth=2,
+            sampling_top_window=64,
+            enable_prefix_cache=True,
+            prefill_chunk_tokens=chunk,
+            enable_mixed_batching=mixed,
+            max_window_prefill_tokens=budget,
+        )
+        engine, fallback_reason = _build_engine_with_fallback(
+            model_cfg,
+            engine_cfg,
+            lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+            [[1, 2, 3]],
+            SamplingParams(temperature=0.0, max_tokens=2),
+        )
+        flight_before = sum(
+            1 for r in get_flight_recorder().snapshot()
+            if r['kind'] == 'mixed'
+        )
+        rids = [
+            engine.add_request(
+                p, SamplingParams(temperature=0.0, max_tokens=n)
+            )
+            for p, n in zip(prompts, budgets)
+        ]
+        start = time.perf_counter()
+        seen: dict = {rid: [] for rid in rids}
+        while engine.has_unfinished:
+            for rid, tok in engine.step():
+                seen[rid].append(tok)
+        elapsed = time.perf_counter() - start
+        n_tokens = sum(len(v) for v in seen.values())
+        load_ttft_s = _measure_load_ttft(
+            engine,
+            prompts[:max_num_seqs],
+            probe_prompt,
+            SamplingParams(temperature=0.0, max_tokens=32),
+            SamplingParams(temperature=0.0, max_tokens=2),
+        )
+        arm = {
+            'tokens': [seen[rid] for rid in rids],
+            'throughput_tok_s': round(n_tokens / elapsed, 2),
+            'prefill_dispatches': int(
+                engine._stats.get('prefill_dispatches', 0)
+            ),
+            'mixed_windows': int(engine._stats.get('mixed_windows', 0)),
+            'mixed_prefill_tokens': int(
+                engine._stats.get('mixed_prefill_tokens', 0)
+            ),
+            'mixed_flight_records': sum(
+                1 for r in get_flight_recorder().snapshot()
+                if r['kind'] == 'mixed'
+            ) - flight_before,
+            'load_ttft_s': (
+                round(load_ttft_s, 3) if load_ttft_s is not None else None
+            ),
+            'fallback_reason': fallback_reason,
+        }
+        engine.shutdown()
+        return arm
+
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
+    off = run_arm(False)
+    on = run_arm(True)
+    warmup_secs = time.perf_counter() - warmup_start
+    identical = on['tokens'] == off['tokens']
+    out = {
+        f'{prefix}metric': 'mixed-window A/B',
+        f'{prefix}tokens_identical': identical,
+        f'{prefix}throughput_tok_s': on['throughput_tok_s'],
+        f'{prefix}off_throughput_tok_s': off['throughput_tok_s'],
+        f'{prefix}load_ttft_s': on['load_ttft_s'],
+        f'{prefix}off_load_ttft_s': off['load_ttft_s'],
+        f'{prefix}prefill_dispatches': on['prefill_dispatches'],
+        f'{prefix}off_prefill_dispatches': off['prefill_dispatches'],
+        f'{prefix}windows': on['mixed_windows'],
+        f'{prefix}prefill_tokens_ridden': on['mixed_prefill_tokens'],
+        f'{prefix}flight_records': on['mixed_flight_records'],
+        f'{prefix}off_flight_records': off['mixed_flight_records'],
+        f'{prefix}elapsed_both_arms_s': round(warmup_secs, 1),
+        f'{prefix}workload': _workload_fingerprint(
+            {'prompts': [list(map(int, p)) for p in prompts],
+             'budgets': budgets,
+             'engine': {'max_num_seqs': max_num_seqs,
+                        'num_blocks': num_blocks,
+                        'max_window_prefill_tokens': budget,
+                        'prefill_chunk_tokens': chunk}}
+        ),
+        **_cache_fields(prefix, cache_before),
+    }
+    if not identical:
+        out[f'{prefix}error'] = (
+            'mixed on/off token mismatch — the A/B identity contract is '
+            'broken'
+        )
+    if on['fallback_reason'] or off['fallback_reason']:
+        out[f'{prefix}attn_fallback_reason'] = (
+            on['fallback_reason'] or off['fallback_reason']
+        )
+    return out
+
+
 def _stage_gen() -> dict:
     return _run_gen(None, 'gen_')
 
@@ -604,15 +840,16 @@ def _chip_peak_flops(device) -> float | None:
 # compile cache (same bf16 7B dims), and int8 gen_q's cold warmup — the
 # round-4 22-45 min outlier — runs last so a deadline truncates the most
 # expensive coverage first, never the headline metrics.
-STAGE_ORDER = ('embed', 'embed_q', 'gen', 'gen_prefix', 'gen_q')
+STAGE_ORDER = ('embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_q')
 NOMINAL_BUDGET_S = {
     'embed': 1200.0,
     'embed_q': 1200.0,
     'gen': 2700.0,
     'gen_prefix': 2700.0,
+    'gen_mixed': 2700.0,
     'gen_q': 2700.0,
 }
-GEN_STAGES = frozenset({'gen', 'gen_q', 'gen_prefix'})
+GEN_STAGES = frozenset({'gen', 'gen_q', 'gen_prefix', 'gen_mixed'})
 # Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
 # stages stop with ~5 min to spare even if the guess is exact, and the
 # SIGTERM handler is the backstop if the real budget is shorter.
@@ -841,6 +1078,7 @@ def _run_stage_entry(stage: str) -> None:
         'gen': _stage_gen,
         'gen_q': _stage_gen_q,
         'gen_prefix': _stage_gen_prefix,
+        'gen_mixed': _stage_gen_mixed,
     }
     watchdog = None
     watchdog_s = float(os.environ.get('DISTLLM_BENCH_WATCHDOG_S', '300') or 0)
@@ -862,7 +1100,10 @@ def _run_stage_entry(stage: str) -> None:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        '--stage', choices=['embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix']
+        '--stage',
+        choices=[
+            'embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix', 'gen_mixed',
+        ],
     )
     args = parser.parse_args()
 

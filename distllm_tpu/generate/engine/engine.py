@@ -37,7 +37,7 @@ from enum import Enum
 import jax
 import jax.numpy as jnp
 import numpy as np
-from pydantic import field_validator
+from pydantic import field_validator, model_validator
 
 from distllm_tpu.generate.engine.kv_cache import (
     PagedKVCache,
@@ -99,6 +99,17 @@ class Request:
     # last prompt token must be recomputed into a private copy of it
     # (copy-on-write, resolved at prefill dispatch).
     cow_src_block: int | None = None
+    # --- mixed serving windows (docs/serving.md) ---
+    # Absolute token counts tracking a prefill tail riding decode windows:
+    # target = tokens that must be prefilled (prompt + any recompute
+    # outputs, set at enrollment), sent = dispatched in some window
+    # (possibly still in flight), done = confirmed by a processed window.
+    # The request joins decode plans only once done >= target (and its
+    # first token was emitted by the final chunk's sample). All three stay
+    # 0 outside mixed mode, which makes every request decode-ready.
+    prefill_target: int = 0
+    prefill_sent: int = 0
+    prefill_done: int = 0
     # --- lifecycle timestamps (flight recorder, docs/observability.md) ---
     # monotonic seconds; 0.0 = not reached. t_admit/t_first_token keep
     # their FIRST value across recompute preemption: the client-visible
@@ -159,12 +170,57 @@ class EngineConfig(BaseConfig):
     # either way.
     decode_layer_unroll: bool = True
 
-    @field_validator('sampling_top_window', 'prefill_chunk_tokens')
+    @field_validator(
+        'sampling_top_window', 'prefill_chunk_tokens',
+        'max_window_prefill_tokens',
+    )
     @classmethod
     def _non_negative_window(cls, v: int, info) -> int:
         if v < 0:
             raise ValueError(f'{info.field_name} must be >= 0')
         return v
+
+    @field_validator('max_window_prefill_seqs')
+    @classmethod
+    def _at_least_one_row(cls, v: int, info) -> int:
+        if v < 1:
+            raise ValueError(f'{info.field_name} must be >= 1')
+        return v
+
+    @model_validator(mode='after')
+    def _mixed_batching_consistent(self):
+        if self.enable_mixed_batching and self.defer_prefill:
+            # Both features re-route prefill emission through the window
+            # pipeline and their bookkeeping (carried-ids scatter vs chunk
+            # plans) conflicts; defer_prefill also measured SLOWER on the
+            # serving tunnel (822 -> 636 tok/s, BENCH_NOTES_r05.md) while
+            # mixed batching attacks the same gap without tiny extra
+            # dispatches — there is no configuration where both win.
+            raise ValueError(
+                'enable_mixed_batching and defer_prefill are mutually '
+                'exclusive: both re-route prefill emission through the '
+                'window pipeline (and defer_prefill measured 822 -> 636 '
+                'tok/s on the r5 serving workload — see defer_prefill '
+                'docs); disable one'
+            )
+        if self.enable_mixed_batching and self.max_window_prefill_tokens < 1:
+            raise ValueError(
+                'enable_mixed_batching needs max_window_prefill_tokens >= 1'
+            )
+        if self.enable_mixed_batching and not (
+            self.enable_prefix_cache or self.prefill_chunk_tokens
+        ):
+            # Only paged-route tails (cache-hit tails / chunk-split spans)
+            # ride windows; without either feature NOTHING can ever
+            # enroll, yet warmup would still compile the whole mixed shape
+            # ladder — multi-minute dead TPU time for a structurally inert
+            # feature. Fail at config time instead of silently.
+            raise ValueError(
+                'enable_mixed_batching needs enable_prefix_cache and/or '
+                'prefill_chunk_tokens: only cache-hit tails and chunked '
+                'spans ride mixed windows (docs/serving.md)'
+            )
+        return self
     # Automatic prefix caching (docs/prefix_caching.md): full prompt
     # blocks enter a hash-chain cache as they prefill; later requests
     # sharing a block-aligned prefix reuse those KV blocks (refcounted,
@@ -187,15 +243,39 @@ class EngineConfig(BaseConfig):
     # Decode windows in flight during generate_ids (2 hides the
     # host<->device round trip behind the next window's compute).
     pipeline_depth: int = 2
-    # Keep prefill's first-token fetch on device and process it with the
-    # in-flight window records (sampled tokens scatter into the carried
-    # last-ids vector). Token-exact either way. Default OFF: on the axon
-    # tunnel the extra tiny dispatches it adds (scatter/merge/slices) cost
-    # more than the 18 blocking sample fetches they remove — measured
-    # 822 -> 636 tok/s on the r5 serving workload (probe_gen,
-    # chipback_r05). Revisit on directly-attached hardware, where
-    # per-dispatch latency is microseconds, not milliseconds.
+    # TUNNEL-ONLY OPT-IN — do not re-enable by default. Keeps prefill's
+    # first-token fetch on device and processes it with the in-flight
+    # window records (sampled tokens scatter into the carried last-ids
+    # vector). Token-exact either way, but MEASURED SLOWER on the serving
+    # tunnel: 822 -> 636 tok/s on the r5 serving workload (probe_gen,
+    # chipback_r05, BENCH_NOTES_r05.md) — the extra tiny dispatches it
+    # adds (scatter/merge/slices) cost more than the 18 blocking sample
+    # fetches they remove. Only a directly-attached deployment (per-
+    # dispatch latency in microseconds, not milliseconds) should even
+    # experiment with it, and enable_mixed_batching is the measured-
+    # faster answer to the same prefill-serialization gap; the validator
+    # rejects enabling both.
     defer_prefill: bool = False
+    # Mixed prefill+decode serving windows (docs/serving.md): each fused
+    # decode dispatch may also carry up to max_window_prefill_tokens of
+    # uncached prefill-tail chunk tokens, so prefill work rides the
+    # weight stream (and the dispatch) the decode window already pays for
+    # instead of serializing between windows — the whole measured gap
+    # between the r5 serving loop (830 tok/s) and the isolated window
+    # rate (1101 tok/s). Token-identical to the separate-prefill path
+    # under greedy sampling (tested); stochastic sampling draws from a
+    # different key-split order.
+    enable_mixed_batching: bool = False
+    # Budget of prefill-chunk tokens one mixed window may carry (the
+    # max_num_batched_tokens analogue for the ridden prefill share).
+    # Chunk spans additionally respect prefill_chunk_tokens when set, so
+    # chunk planning composes with the PR-2 chunked-prefill buckets.
+    max_window_prefill_tokens: int = 256
+    # Prefill-chunk ROWS (distinct requests) per mixed window. Each
+    # (rows, bucket) pair is a compiled window shape; keep this small —
+    # on TPU every extra mixed shape is another multi-minute unrolled-
+    # window compile at warmup (see docs/serving.md).
+    max_window_prefill_seqs: int = 2
     seed: int = 0
 
 
@@ -396,6 +476,31 @@ class LLMEngine:
             )
 
         self._decode_window = jax.jit(window_fn, donate_argnums=(4, 5))
+
+        # Mixed serving windows: chunk rows + the decode scan in ONE
+        # dispatch (mistral.mixed_window; docs/serving.md). Built only
+        # when enabled — the shapes are extra compiles a pure-decode
+        # deployment never wants.
+        def mixed_fn(
+            params, ids, pos, ctx, k, v, bt, steps_left, temp, top_p,
+            min_p, key, c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp,
+            c_top_p, c_min_p,
+        ):
+            return mistral.mixed_window(
+                params, model, ids, pos, k, v, bt, ctx, steps_left,
+                temp, top_p, min_p, key, c_ids, c_pos, c_bt, c_ctx,
+                c_tails, c_temp, c_top_p, c_min_p, num_steps=num_steps,
+                attn_backend=attn_backend, max_table_positions=max_tables,
+                sampling_top_window=cfg.sampling_top_window,
+                layer_unroll=cfg.decode_layer_unroll,
+            )
+
+        self._mixed_fn = mixed_fn
+        self._mixed_window = (
+            jax.jit(mixed_fn, donate_argnums=(4, 5))
+            if cfg.enable_mixed_batching
+            else None
+        )
         # Resolved-at-serve-time values: a config that believes it enabled
         # the Pallas kernel can otherwise ship 3x slower with no signal.
         self.telemetry: dict[str, str] = {'attn_backend': attn_backend}
@@ -425,6 +530,7 @@ class LLMEngine:
                 # callers rebuild with fresh params (see bench.py ladder).
                 self.params = self._migrate_params(formats)
                 self._decode_window = compiled
+                self._pin_mixed_layout(formats)
         self.kv.allocate()
         # Merge host-known overrides (fresh admissions) into the device-
         # carried last-token vector between pipelined windows.
@@ -442,6 +548,10 @@ class LLMEngine:
         # Tokens dispatched on device but not yet fetched, per request —
         # the pipelined path's lag bookkeeping.
         self._unacked: dict[int, int] = {}
+        # Requests whose uncached prefill tail rides mixed windows, in
+        # FIFO dispatch order (rids; entries are dropped at final-chunk
+        # processing, preemption, or lazily when a request vanishes).
+        self._prefilling: list[int] = []
         # Set by _run_to_completion: lets chunked prefill retire one
         # in-flight decode window between chunks.
         self._drain_hook = None
@@ -605,6 +715,31 @@ class LLMEngine:
             ) from exc
         return jax.tree.unflatten(treedef, migrated)
 
+    def _pin_mixed_layout(self, formats) -> None:
+        """Re-jit the mixed window with params pinned to the migrated
+        layouts (TPU auto-layout path). Without this, the lazily compiled
+        mixed executable would ask for default layouts and XLA would
+        insert multi-GiB relayout copies of the stacked kernels inside
+        every chunk-carrying window — silently repaying the bandwidth the
+        migration bought."""
+        if self._mixed_window is None:
+            return
+        try:  # pragma: no cover - TPU-only path
+            from jax.experimental.layout import Format
+            from jax.sharding import SingleDeviceSharding
+
+            sharding = SingleDeviceSharding(jax.devices()[0])
+            pinned = jax.tree.map(
+                lambda fmt: Format(fmt.layout, sharding), formats
+            )
+            self._mixed_window = jax.jit(
+                self._mixed_fn,
+                donate_argnums=(4, 5),
+                in_shardings=(pinned,) + (Format(),) * 19,
+            )
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            self.telemetry['mixed_layout_fallback'] = repr(exc)[:300]
+
     def warmup(self) -> None:
         """Compile every serving shape outside the request path.
 
@@ -708,6 +843,46 @@ class LLMEngine:
             self._put(np.zeros((bsz,), bool)),
             self._put(np.zeros((bsz,), np.int32)),
         )
+        if self._mixed_window is not None:
+            # Warm every mixed-window shape the chunk planner can emit:
+            # rows always pad to the pow2 of max_window_prefill_seqs, so
+            # only the chunk-token bucket varies (ladder capped at the
+            # window budget). tail_lens 0 + all-zero tables route every
+            # write to the trash block; steps_left 0 freezes decode.
+            cb = self._mixed_rows()
+            span_bucket = pick_bucket(
+                self._mixed_span_cap(), self.prefill_buckets
+            )
+            for bucket in self.prefill_buckets:
+                if bucket > span_bucket:
+                    break
+                mixed_tokens, self.kv.k, self.kv.v, _, _ = self._mixed_window(
+                    self.params,
+                    self._put(np.zeros((bsz,), np.int32)),
+                    self._put(np.zeros((bsz,), np.int32)),
+                    self._put(np.ones((bsz,), np.int32)),
+                    self.kv.k,
+                    self.kv.v,
+                    self._put(
+                        np.zeros((bsz, self.max_blocks_per_seq), np.int32)
+                    ),
+                    self._put(np.zeros((bsz,), np.int32)),
+                    self._put(np.zeros((bsz,), np.float32)),
+                    self._put(np.ones((bsz,), np.float32)),
+                    self._put(np.zeros((bsz,), np.float32)),
+                    jax.random.PRNGKey(0),
+                    self._put(np.zeros((cb, bucket), np.int32)),
+                    self._put(np.zeros((cb, bucket), np.int32)),
+                    self._put(
+                        np.zeros((cb, self.max_blocks_per_seq), np.int32)
+                    ),
+                    self._put(np.ones((cb,), np.int32)),
+                    self._put(np.zeros((cb,), np.int32)),
+                    self._put(np.zeros((cb,), np.float32)),
+                    self._put(np.ones((cb,), np.float32)),
+                    self._put(np.zeros((cb,), np.float32)),
+                )
+                np.asarray(mixed_tokens)
         # On this backend block_until_ready does not synchronize; a tiny
         # host fetch is the only reliable completion barrier.
         np.asarray(tokens)
@@ -799,12 +974,35 @@ class LLMEngine:
             groups: dict[int, list[Request]] = {}
             paged: list[Request] = []
             chunk = self.config.prefill_chunk_tokens
+            # Mixed batching: once windows are flowing, admitted tails ride
+            # them as chunk rows instead of standalone dispatches. Decided
+            # once per admitted batch — at cold start nothing is decoding,
+            # so the first batch prefills standalone and bootstraps the
+            # stream the rest ride.
+            ride = (
+                self.config.enable_mixed_batching and self._mixed_can_ride()
+            )
             for request in admitted:
                 # Re-prefill covers generated tokens too (recompute
                 # preemption path) but never the cached prefix — tail-only
                 # prefill is the prefix cache's whole win.
                 tail = request.num_tokens - request.num_cached_tokens
-                if request.num_cached_tokens or (chunk and tail > chunk):
+                paged_route = bool(
+                    request.num_cached_tokens or (chunk and tail > chunk)
+                )
+                if ride and paged_route:
+                    # Only paged-route tails ride windows: their spans go
+                    # through the SAME ragged write-then-attend kernel as
+                    # the standalone paged dispatch (key extent is always
+                    # the full padded table), so mixed on/off stay bit-
+                    # identical even in bf16. Fresh short prompts keep the
+                    # batched dense prefill — a different kernel (bf16
+                    # bits differ at scale) AND the better dispatch: one
+                    # padded batch beats trickling them through budget-
+                    # limited windows.
+                    self._enroll_mixed(request)
+                    continue
+                if paged_route:
                     paged.append(request)
                     continue
                 bucket = pick_bucket(tail, self.prefill_buckets)
@@ -890,6 +1088,141 @@ class LLMEngine:
         while seqs_ceil < self.config.max_num_seqs:
             seqs_ceil *= 2
         return min(b, seqs_ceil)
+
+    # ------------------------------------------------ mixed serving windows
+    def _mixed_rows(self) -> int:
+        """Chunk-row count of every mixed dispatch: the pow2 ceiling of
+        ``max_window_prefill_seqs``. FIXED (planner under-fills with trash
+        rows) so the row dim never adds compiled shapes — only the chunk
+        token bucket varies."""
+        b = 1
+        while b < self.config.max_window_prefill_seqs:
+            b *= 2
+        return b
+
+    def _mixed_span_cap(self) -> int:
+        """Largest chunk span one request may ride per window: the window
+        budget, further capped by ``prefill_chunk_tokens`` when set so
+        mixed chunk planning composes with the chunked-prefill buckets."""
+        cap = min(
+            self.config.max_window_prefill_tokens,
+            self.config.max_model_len,
+        )
+        if self.config.prefill_chunk_tokens:
+            cap = min(cap, self.config.prefill_chunk_tokens)
+        return max(1, cap)
+
+    @staticmethod
+    def _decode_ready(request: Request) -> bool:
+        """May this running request take decode steps? False only while
+        its prefill tail is still riding mixed windows (the final chunk's
+        processed sample is what turns it decode-ready)."""
+        return request.prefill_done >= request.prefill_target
+
+    def _mixed_can_ride(self) -> bool:
+        """True when windows are flowing for chunks to ride: some running
+        request is actively decoding (emitted or in-flight tokens), or
+        chunk work is already pending (chunk-only windows keep dispatching
+        until it drains). At cold start neither holds and admission uses
+        the standalone prefill path — a chunk-only window would pay the
+        full ``decode_steps`` weight stream for a handful of prefill
+        tokens, so the engine bootstraps the stream before anything rides
+        it. Freshly admitted same-batch requests don't count: they have
+        neither output nor unacked tokens yet."""
+        if self._prefilling:
+            return True
+        for _, rid in self.sched.running():
+            request = self._requests[rid]
+            if request.output_ids or self._unacked.get(rid):
+                return True
+        return False
+
+    def _enroll_mixed(self, request: Request) -> None:
+        """Route this admitted request's uncached tail through mixed
+        windows. COW resolves here (admission) rather than at prefill
+        dispatch — the source block's contents are already final, so the
+        copy is value-identical at either point."""
+        if request.cow_src_block is not None:
+            self._resolve_cow([request])
+        request.prefill_target = request.num_tokens
+        request.prefill_sent = request.num_cached_tokens
+        request.prefill_done = request.num_cached_tokens
+        self._prefilling.append(request.request_id)
+
+    def _plan_window_chunks(self) -> list[tuple[Request, int, int]]:
+        """Chunk spans riding the next window: FIFO over mid-prefill
+        requests, one span each, bounded by the window token budget, the
+        row cap, and the span cap. Returns ``[(request, start, ntok)]``
+        in absolute tokens; prunes stale (finished/preempted) entries."""
+        if self._mixed_window is None or not self._prefilling:
+            return []
+        budget = self.config.max_window_prefill_tokens
+        span_cap = self._mixed_span_cap()
+        plan: list[tuple[Request, int, int]] = []
+        for rid in list(self._prefilling):
+            if budget <= 0 or len(plan) >= self.config.max_window_prefill_seqs:
+                break
+            request = self._requests.get(rid)
+            if request is None or request.state is not RequestState.RUNNING:
+                self._prefilling.remove(rid)
+                continue
+            remaining = request.prefill_target - request.prefill_sent
+            if remaining <= 0:
+                continue  # final chunk already in flight
+            ntok = min(remaining, span_cap, budget)
+            plan.append((request, request.prefill_sent, ntok))
+            budget -= ntok
+        return plan
+
+    def _span_host_arrays(self, spans, bucket: int, rows: int):
+        """The padded paged-span host arrays — (ids, positions,
+        block_rows, context_lens, tail_lens) — for ``spans`` =
+        ``[(request, start, ntok)]``. ONE builder shared by standalone
+        paged prefill and mixed chunk rows: the span/padding contract
+        (trash-routed pads, clamped RoPE positions) is exactly what the
+        mixed-vs-pure bit-identity guarantee rests on, so it must not be
+        able to diverge between the two dispatch paths. Pad rows carry
+        tail 0 + all-zero tables: writes land in the trash block and
+        their logits are garbage the caller discards."""
+        ids = np.zeros((rows, bucket), np.int32)
+        positions = np.zeros((rows, bucket), np.int32)
+        block_rows = np.zeros((rows, self.max_blocks_per_seq), np.int32)
+        context_lens = np.ones((rows,), np.int32)
+        tail_lens = np.zeros((rows,), np.int32)
+        max_pos = self.config.max_model_len - 1
+        for i, (request, start, ntok) in enumerate(spans):
+            toks = (request.prompt_ids + request.output_ids)[
+                start : start + ntok
+            ]
+            ids[i, :ntok] = toks
+            # Padding columns clamp to max_model_len-1 so the RoPE table
+            # gather stays in range; their writes are masked to trash.
+            positions[i] = np.minimum(start + np.arange(bucket), max_pos)
+            block_rows[i] = self._block_row(request.request_id)
+            context_lens[i] = start + ntok
+            tail_lens[i] = ntok
+        return ids, positions, block_rows, context_lens, tail_lens
+
+    def _build_chunk_arrays(self, chunk_plan) -> list[np.ndarray]:
+        """Host arrays for a mixed window's chunk rows, in the mixed
+        executable's operand order: the shared span arrays plus per-row
+        sampling params. Rows pad to the FIXED ``_mixed_rows()`` count."""
+        cb = self._mixed_rows()
+        bucket = pick_bucket(
+            max(ntok for _, _, ntok in chunk_plan), self.prefill_buckets
+        )
+        ids, positions, block_rows, context_lens, tail_lens = (
+            self._span_host_arrays(chunk_plan, bucket, cb)
+        )
+        c_temp = np.zeros((cb,), np.float32)
+        c_top_p = np.ones((cb,), np.float32)
+        c_min_p = np.zeros((cb,), np.float32)
+        for i, (request, _, _) in enumerate(chunk_plan):
+            c_temp[i] = request.params.temperature
+            c_top_p[i] = request.params.top_p
+            c_min_p[i] = request.params.min_p
+        return [ids, positions, block_rows, context_lens, tail_lens,
+                c_temp, c_top_p, c_min_p]
 
     # -------------------------------------------------------------- prefill
     def _run_prefill_batch(
@@ -1103,23 +1436,9 @@ class LLMEngine:
         b = 1
         while b < len(spans):
             b *= 2
-        ids = np.zeros((b, bucket), np.int32)
-        positions = np.zeros((b, bucket), np.int32)
-        context_lens = np.ones((b,), np.int32)
-        tail_lens = np.zeros((b,), np.int32)
-        block_rows = np.zeros((b, self.max_blocks_per_seq), np.int32)
-        max_pos = self.config.max_model_len - 1
-        for i, (request, start, ntok) in enumerate(spans):
-            toks = (request.prompt_ids + request.output_ids)[
-                start : start + ntok
-            ]
-            ids[i, :ntok] = toks
-            # Padding columns clamp to max_model_len-1 so the RoPE table
-            # gather stays in range; their writes are masked to trash.
-            positions[i] = np.minimum(start + np.arange(bucket), max_pos)
-            context_lens[i] = start + ntok
-            tail_lens[i] = ntok
-            block_rows[i] = self._block_row(request.request_id)
+        ids, positions, block_rows, context_lens, tail_lens = (
+            self._span_host_arrays(spans, bucket, b)
+        )
         (
             ids_dev,
             positions_dev,
@@ -1195,13 +1514,15 @@ class LLMEngine:
             request.num_borrowed_blocks = lent
 
     def _record_step(self, kind: str, t_start: float, *, batch: int,
-                     tokens: int) -> None:
+                     tokens: int, **extra) -> None:
         """One flight-ring record + metrics pair per engine step.
 
         ``duration_s`` for prefill is the host-side dispatch (+ sync
-        emission on the synchronous path); for decode it spans dispatch →
-        host fetch, so pipelined in-flight time is included — the wall
-        clock a stalled window would actually burn.
+        emission on the synchronous path); for decode/mixed it spans
+        dispatch → host fetch, so pipelined in-flight time is included —
+        the wall clock a stalled window would actually burn. ``extra``
+        carries kind-specific fields (the ``mixed`` kind adds
+        prefill_tokens/prefill_rows).
         """
         duration_s = time.monotonic() - t_start
         _metrics.ENGINE_STEPS.labels(kind=kind).inc()
@@ -1218,6 +1539,7 @@ class LLMEngine:
             kv_occupancy=round(
                 (usable - self.sched.num_free_blocks) / usable, 4
             ) if usable > 0 else 0.0,
+            **extra,
         )
 
     def _block_row(self, rid: int) -> np.ndarray:
@@ -1251,7 +1573,10 @@ class LLMEngine:
 
     def _window_budget(self, request: Request, unacked: int, k: int) -> int:
         """Tokens this request may still generate in a new window, after
-        accounting for unfetched device-side tokens."""
+        accounting for unfetched device-side tokens. Zero while the
+        request's prefill tail is still riding mixed windows."""
+        if not self._decode_ready(request):
+            return 0
         budget = min(
             request.params.max_tokens - len(request.output_ids) - unacked,
             self.config.max_model_len - request.num_tokens - unacked,
@@ -1279,6 +1604,14 @@ class LLMEngine:
         short = 0
         for _, rid in self.sched.running():
             request = self._requests[rid]
+            if not self._decode_ready(request):
+                # Mixed prefill rows take no decode steps this window and
+                # their chunk writes land in blocks granted at admission
+                # (the full prompt is budgeted up front) — mirrors
+                # prepare_decode(kmax, rids=decode-ready) below, so the
+                # pipelined drain-before-preempt guard and the scheduler
+                # agree on the shortfall.
+                continue
             target = -(-(request.num_tokens + kmax) // bs)
             short += max(0, target - len(self.sched.block_row(rid)))
         return short
@@ -1289,32 +1622,49 @@ class LLMEngine:
         ``carried_ids`` is the previous window's device-side last-token
         vector (None = build fully from host knowledge). Slots with no
         unacked tokens are overridden from host state — fresh admissions,
-        reused slots, or a drained pipeline. Returns the in-flight window
-        record, or ``_DRAIN`` when every running slot's budget is already
-        covered by in-flight windows (caller should process one).
+        reused slots, or a drained pipeline. Under mixed batching the
+        window may additionally carry prefill-chunk rows (planned below)
+        and dispatch through the fused mixed executable. Returns the
+        in-flight window record, or ``_DRAIN`` when every running slot's
+        budget is already covered by in-flight windows AND no chunk work
+        is pending (caller should process one).
         """
         k = self.config.decode_steps
         kmax = self._window_kmax()
-        # Eviction pressure beats preemption: unreferenced cached blocks
-        # are free capacity, so spend those before recompute-preempting a
-        # running sequence.
-        self._evict_cached_blocks(
-            self._reserve_shortfall(kmax) - self.sched.num_free_blocks
-        )
-        try:
-            preempted = self.sched.prepare_decode(kmax)
-        except SchedulerExhausted as exc:
-            # Preemptions performed before the fatal exhaustion are not
-            # rolled back; sync their states so a caller that catches and
-            # continues sees engine state consistent with the scheduler.
-            for rid in exc.preempted:
+        decode_rids = None
+        if self.config.enable_mixed_batching:
+            decode_rids = [
+                rid for _, rid in self.sched.running()
+                if self._decode_ready(self._requests[rid])
+            ]
+        if decode_rids is None or decode_rids:
+            # Eviction pressure beats preemption: unreferenced cached
+            # blocks are free capacity, so spend those before recompute-
+            # preempting a running sequence.
+            self._evict_cached_blocks(
+                self._reserve_shortfall(kmax) - self.sched.num_free_blocks
+            )
+            try:
+                preempted = self.sched.prepare_decode(kmax, decode_rids)
+            except SchedulerExhausted as exc:
+                # Preemptions performed before the fatal exhaustion are not
+                # rolled back; sync their states so a caller that catches
+                # and continues sees engine state consistent with the
+                # scheduler.
+                for rid in exc.preempted:
+                    self._on_preempt(self._requests[rid])
+                raise
+            for rid in preempted:
+                # The pipelined loop drains in-flight windows before any
+                # dispatch that could preempt, so victims never have
+                # unacked device-side tokens OR in-flight chunk writes;
+                # recompute preemption re-prefills them.
                 self._on_preempt(self._requests[rid])
-            raise
-        for rid in preempted:
-            # The pipelined loop drains in-flight windows before any
-            # dispatch that could preempt, so victims never have unacked
-            # device-side tokens; recompute preemption re-prefills them.
-            self._on_preempt(self._requests[rid])
+        # A chunk-only window (no decode-ready rows) skips prepare_decode
+        # entirely: chunk writes land in admission-granted blocks, so it
+        # must neither allocate nor preempt. Planned AFTER preemption so
+        # a preempted victim's span never rides this window.
+        chunk_plan = self._plan_window_chunks()
         running = [
             (slot, self._requests[rid]) for slot, rid in self.sched.running()
         ]
@@ -1354,9 +1704,16 @@ class LLMEngine:
                 override_mask[slot] = True
             plan.append((slot, rid, steps))
             any_steps = any_steps or steps > 0
-        if not any_steps:
+        if not any_steps and not chunk_plan:
             return _DRAIN
 
+        host_arrays = [
+            ids, override_mask, positions, context_lens, block_tables,
+            steps_left, temperature, top_p, min_p,
+        ]
+        if chunk_plan:
+            host_arrays.extend(self._build_chunk_arrays(chunk_plan))
+        devs = self._put_many(*host_arrays)
         (
             ids_dev,
             override_dev,
@@ -1367,34 +1724,63 @@ class LLMEngine:
             temperature_dev,
             top_p_dev,
             min_p_dev,
-        ) = self._put_many(
-            ids,
-            override_mask,
-            positions,
-            context_lens,
-            block_tables,
-            steps_left,
-            temperature,
-            top_p,
-            min_p,
-        )
+        ) = devs[:9]
         if carried_ids is not None:
             ids_dev = self._merge_ids(carried_ids, override_dev, ids_dev)
         self._key, key = jax.random.split(self._key)
-        tokens, self.kv.k, self.kv.v, last_ids = self._decode_window(
-            self.params,
-            ids_dev,
-            positions_dev,
-            context_lens_dev,
-            self.kv.k,
-            self.kv.v,
-            block_tables_dev,
-            steps_left_dev,
-            temperature_dev,
-            top_p_dev,
-            min_p_dev,
-            key,
-        )
+        chunk_tokens = None
+        chunk_entries: list[tuple[int, int, int, int, bool]] = []
+        if chunk_plan:
+            (
+                tokens,
+                self.kv.k,
+                self.kv.v,
+                last_ids,
+                chunk_tokens,
+            ) = self._mixed_window(
+                self.params,
+                ids_dev,
+                positions_dev,
+                context_lens_dev,
+                self.kv.k,
+                self.kv.v,
+                block_tables_dev,
+                steps_left_dev,
+                temperature_dev,
+                top_p_dev,
+                min_p_dev,
+                key,
+                *devs[9:],
+            )
+            ridden = 0
+            for i, (request, start, ntok) in enumerate(chunk_plan):
+                request.prefill_sent = start + ntok
+                final = start + ntok >= request.prefill_target
+                chunk_entries.append(
+                    (i, request.request_id, start, ntok, final)
+                )
+                ridden += ntok
+            self._stats['mixed_windows'] += 1
+            self._stats['mixed_prefill_tokens'] += ridden
+            _metrics.MIXED_WINDOWS.inc()
+            _metrics.MIXED_PREFILL_TOKENS.inc(ridden)
+            _metrics.MIXED_PREFILL_TOKENS_PER_WINDOW.observe(ridden)
+            _metrics.MIXED_PREFILL_ROWS.observe(len(chunk_plan))
+        else:
+            tokens, self.kv.k, self.kv.v, last_ids = self._decode_window(
+                self.params,
+                ids_dev,
+                positions_dev,
+                context_lens_dev,
+                self.kv.k,
+                self.kv.v,
+                block_tables_dev,
+                steps_left_dev,
+                temperature_dev,
+                top_p_dev,
+                min_p_dev,
+                key,
+            )
         for _, rid, steps in plan:
             if steps:
                 self._unacked[rid] = self._unacked.get(rid, 0) + steps
@@ -1408,6 +1794,8 @@ class LLMEngine:
             'plan': plan,
             'last_ids': last_ids,
             't_dispatch': time.monotonic(),
+            'chunk_tokens': chunk_tokens,
+            'chunk_plan': chunk_entries,
         }
 
     def _on_preempt(self, request: Request) -> None:
@@ -1418,6 +1806,17 @@ class LLMEngine:
             request.num_cached_tokens = (
                 request.num_borrowed_blocks * self.config.block_size
             )
+        # Mixed chunk progress is recompute state too: chunks past the
+        # borrowed prefix lived in the freed owned blocks. target 0 =
+        # decode-ready-by-default; re-admission re-enrolls (or prefills
+        # standalone) with a fresh target.
+        request.prefill_target = 0
+        request.prefill_sent = request.num_cached_tokens
+        request.prefill_done = request.num_cached_tokens
+        try:
+            self._prefilling.remove(request.request_id)
+        except ValueError:
+            pass
 
     def _process_window(self, window: dict) -> list[tuple[int, int]]:
         """Fetch one window's tokens (the only host sync in the decode
@@ -1427,12 +1826,20 @@ class LLMEngine:
         hidden dispatch latency)."""
         tokens = np.asarray(window['tokens'])  # [K, B]
         emitted: list[tuple[int, int]] = []
+        chunk_entries = window.get('chunk_plan') or []
         if 't_dispatch' in window:  # prefill fetch records carry no clock
+            extra = {}
+            if chunk_entries:
+                extra = {
+                    'prefill_tokens': sum(n for *_, n, _ in chunk_entries),
+                    'prefill_rows': len(chunk_entries),
+                }
             self._record_step(
-                'decode',
+                'mixed' if chunk_entries else 'decode',
                 window['t_dispatch'],
                 batch=sum(1 for _, _, s in window['plan'] if s > 0),
                 tokens=sum(s for _, _, s in window['plan']),
+                **extra,
             )
         for slot, rid, steps in window['plan']:
             if rid in self._unacked:
@@ -1452,6 +1859,31 @@ class LLMEngine:
                     self._stats['overshoot_tokens'] += steps - i - 1
                     _metrics.ENGINE_OVERSHOOT_TOKENS.inc(steps - i - 1)
                     break  # finished mid-window
+        if chunk_entries:
+            # The fetch above is the completion barrier: once the window's
+            # tokens are on host, its chunk K/V writes are in the cache.
+            chunk_tokens = np.asarray(window['chunk_tokens'])
+            for row_i, rid, start, ntok, final in chunk_entries:
+                request = self._requests.get(rid)
+                if request is None or request.state is not RequestState.RUNNING:
+                    continue  # preempted during an abnormal drain
+                request.prefill_done = max(
+                    request.prefill_done, start + ntok
+                )
+                if final:
+                    # Freshly prefilled full prompt blocks enter the
+                    # prefix cache BEFORE emission — a max_tokens=1
+                    # request finishes inside _emit_token, after which
+                    # its row is gone (same ordering as the standalone
+                    # paths).
+                    self._insert_prompt_blocks(request)
+                    try:
+                        self._prefilling.remove(rid)
+                    except ValueError:
+                        pass
+                    token = int(chunk_tokens[row_i])
+                    self._emit_token(request, token)
+                    emitted.append((rid, token))
         return emitted
 
     def _run_to_completion(self) -> None:
@@ -1508,13 +1940,23 @@ class LLMEngine:
         except BaseException:
             # Keep catch-and-continue recovery sound (the SchedulerExhausted
             # contract): fold every dispatched window back into request
-            # state so no _unacked counts or device-side tokens are orphaned.
+            # state so no _unacked counts, device-side tokens, or in-flight
+            # chunk spans are orphaned.
             while inflight:
                 try:
                     process_one()
                 except Exception:
                     inflight.clear()
                     self._unacked.clear()
+            # The mixed analogue of clearing _unacked: a chunk span whose
+            # window was dropped above advanced prefill_sent but never
+            # prefill_done — rolling sent back lets the span re-ride after
+            # a catch-and-continue resume (otherwise the planner skips the
+            # request as 'in flight' forever and the loop livelocks).
+            for rid in self._prefilling:
+                request = self._requests.get(rid)
+                if request is not None:
+                    request.prefill_sent = request.prefill_done
             raise
         finally:
             self._drain_hook = None

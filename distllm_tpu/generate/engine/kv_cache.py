@@ -15,6 +15,13 @@ hash-chain → block-id map with per-block request refcounts and LRU
 eviction of unreferenced blocks. It owns the REUSE policy only — physical
 block accounting stays with the scheduler, which marks cache-held blocks
 as a request's "borrowed prefix" (``scheduler.py``).
+
+Mixed serving windows (docs/serving.md) write prefill-chunk K/V inside
+decode dispatches; those writes always land in blocks the owning request
+was granted at admission (the full prompt is budgeted up front), so no
+block here ever changes owner while a window is in flight — the engine's
+drain-before-preempt guard plus ``prepare_decode(..., rids=...)`` keep
+that invariant.
 """
 
 from __future__ import annotations
@@ -192,7 +199,15 @@ class PrefixCache:
         # first). Entries stay in _entries while evictable.
         self._evictable: 'OrderedDict[bytes, int]' = OrderedDict()
         self._held: dict[int, list[bytes]] = {}  # rid -> digests referenced
-        self.stats = {'hit_blocks': 0, 'evictions': 0, 'inserts': 0}
+        self.stats = {
+            'hit_blocks': 0, 'evictions': 0, 'inserts': 0,
+            # First-writer-wins losses: a second request prefilled the same
+            # block before this insert landed. Mixed serving windows stretch
+            # a prompt's prefill over several windows (blocks adopted only at
+            # the final chunk), so same-prefix requests admitted meanwhile
+            # prefill private duplicates — this counts that lost sharing.
+            'insert_dupes': 0,
+        }
 
     # ------------------------------------------------------------- lookup
     def match(self, digests: Sequence[bytes]) -> list[int]:
@@ -232,6 +247,7 @@ class PrefixCache:
         block stays private to it (freed by the scheduler at finish).
         """
         if digest in self._entries:
+            self.stats['insert_dupes'] += 1
             return False
         self._entries[digest] = _CacheEntry(
             block_id, refcount=1, holders={rid}

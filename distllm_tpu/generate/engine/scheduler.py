@@ -16,10 +16,13 @@ Policy contract (both implementations, tested in lockstep):
   blocks for ``num_tokens + 1`` are available (all-or-nothing). Blocks a
   request already carries (a borrowed prefix-cache prefix) count toward
   that budget: only the shortfall is allocated.
-- ``prepare_decode(k)`` guarantees every running sequence can take ``k``
-  more tokens (k > 1 backs multi-step fused decode windows), preempting
-  the youngest (highest rid) on OOM — recompute preemption: blocks freed,
-  request to the FRONT of the waiting queue.
+- ``prepare_decode(k, rids=None)`` guarantees every running sequence can
+  take ``k`` more tokens (k > 1 backs multi-step fused decode windows),
+  preempting the youngest (highest rid) on OOM — recompute preemption:
+  blocks freed, request to the FRONT of the waiting queue. ``rids``
+  restricts the guarantee to the listed rows (mixed serving windows:
+  rows whose prefill chunks ride the window get no speculative decode
+  headroom — their blocks were fully allocated at admission).
 - Block 0 is the reserved trash block and is never allocated.
 
 Borrowed prefixes (automatic prefix caching, docs/prefix_caching.md): a
@@ -62,7 +65,9 @@ class Scheduler(Protocol):
 
     def admit_next(self) -> int | None: ...
 
-    def prepare_decode(self, k: int = 1) -> list[int]: ...
+    def prepare_decode(
+        self, k: int = 1, rids: 'list[int] | None' = None
+    ) -> list[int]: ...
 
     def append_token(self, rid: int) -> None: ...
 
@@ -180,13 +185,24 @@ class PyScheduler:
             req.blocks.append(self._free.pop())
         return True
 
-    def prepare_decode(self, k: int = 1) -> list[int]:
+    def prepare_decode(
+        self, k: int = 1, rids: 'list[int] | None' = None
+    ) -> list[int]:
+        """``rids`` (mixed serving windows) restricts the k-token capacity
+        guarantee to the listed running requests: rows mid-prefill inside
+        a mixed window already own blocks for their full prompt from
+        admission, so extending them too would waste pool and provoke
+        spurious preemptions. Victims are still chosen youngest-first over
+        ALL running rows. ``None`` = every running row (classic policy)."""
         if k < 1:
             raise ValueError('k must be >= 1')
+        selected = None if rids is None else set(rids)
         preempted: list[int] = []
         for rid in list(self._slots):
             if rid < 0:
                 continue
+            if selected is not None and rid not in selected:
+                continue  # not selected for decode this window
             req = self._requests[rid]
             if req.slot < 0:
                 continue  # preempted earlier in this loop
@@ -308,6 +324,14 @@ class NativeScheduler:
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.sched_prepare_decode_rows.restype = ctypes.c_int32
+        lib.sched_prepare_decode_rows.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         for name in ('sched_append_token', 'sched_finish'):
             fn = getattr(lib, name)
             fn.restype = ctypes.c_int32
@@ -371,11 +395,21 @@ class NativeScheduler:
             )
         return None if rid < 0 else rid
 
-    def prepare_decode(self, k: int = 1) -> list[int]:
+    def prepare_decode(
+        self, k: int = 1, rids: 'list[int] | None' = None
+    ) -> list[int]:
         if k < 1:
             raise ValueError('k must be >= 1')
         out = (ctypes.c_int64 * self._max_num_seqs)()
-        n = int(self._lib.sched_prepare_decode_k(self._handle, k, out))
+        if rids is None:
+            n = int(self._lib.sched_prepare_decode_k(self._handle, k, out))
+        else:
+            arr = (ctypes.c_int64 * max(1, len(rids)))(*rids)
+            n = int(
+                self._lib.sched_prepare_decode_rows(
+                    self._handle, k, arr, len(rids), out
+                )
+            )
         if n < 0:
             # Fatal encoding is -(1 + n_preempted): preemptions already
             # performed are not rolled back and must reach the engine.
@@ -506,9 +540,11 @@ class InstrumentedScheduler:
             self._m.SCHED_DEFERRED.inc()
         return rid
 
-    def prepare_decode(self, k: int = 1) -> list[int]:
+    def prepare_decode(
+        self, k: int = 1, rids: 'list[int] | None' = None
+    ) -> list[int]:
         try:
-            preempted = self._inner.prepare_decode(k)
+            preempted = self._inner.prepare_decode(k, rids)
         except SchedulerExhausted as exc:
             # Preemptions performed before the fatal exhaustion still
             # happened; count them before propagating.

@@ -82,6 +82,19 @@ class TpuGeneratorConfig(BaseConfig):
         'sequential chunks so one long prompt cannot stall decode '
         '(0 disables chunking).',
     )
+    enable_mixed_batching: bool | None = Field(
+        default=None,
+        description='Mixed prefill+decode serving windows: cache-hit '
+        'tails and chunked prefill spans ride INSIDE the fused decode '
+        'dispatches instead of serializing between them '
+        '(docs/serving.md). Token-identical under greedy sampling.',
+    )
+    max_window_prefill_tokens: int | None = Field(
+        default=None,
+        ge=0,
+        description='Prefill-chunk token budget one mixed window may '
+        'carry (each token bucket is one extra compiled window shape).',
+    )
 
     @model_validator(mode='after')
     def _xor_top_p_min_p(self) -> 'TpuGeneratorConfig':
@@ -219,6 +232,14 @@ class TpuGenerator:
                         ('decode_layer_unroll', config.decode_layer_unroll),
                         ('enable_prefix_cache', config.enable_prefix_cache),
                         ('prefill_chunk_tokens', config.prefill_chunk_tokens),
+                        (
+                            'enable_mixed_batching',
+                            config.enable_mixed_batching,
+                        ),
+                        (
+                            'max_window_prefill_tokens',
+                            config.max_window_prefill_tokens,
+                        ),
                     )
                     if value is not None
                 },

@@ -346,14 +346,15 @@ def prefill_paged(
     scatter afterwards), the caches ride the layer scan: each layer writes
     its tail K/V into its cache plane FIRST, then the tail queries attend
     over the paged cache — cached prefix and own chunk together — via
-    :func:`~distllm_tpu.ops.paged_attention.paged_prefill_attention_xla`.
+    :func:`~distllm_tpu.ops.paged_attention.ragged_paged_attention_xla`
+    (``q_lens=tail_lens`` — the rows are ragged per-row query spans).
     Returns ``(last_logits [B, V] fp32, k_cache, v_cache)`` where
     ``last_logits`` is sampled at each row's last valid tail position.
     Positions at or past ``tail_lens`` (padding) write to trash block 0
     and their logits are garbage the caller discards.
     """
     from distllm_tpu.ops.paged_attention import (
-        paged_prefill_attention_xla,
+        ragged_paged_attention_xla,
         write_chunk_kv,
     )
 
@@ -402,8 +403,15 @@ def prefill_paged(
         k_cache_l, v_cache_l = write_chunk_kv(
             k_cache_l, v_cache_l, k, v, block_tables, positions, valid
         )
-        attn = paged_prefill_attention_xla(
+        # q_lens masks PADDING queries onto key 0: under a sliding window
+        # a pad query past the window's reach otherwise has an all-masked
+        # score row -> NaN attention -> NaN K/V written to the TRASH
+        # block -> every later dispatch whose block-table padding gathers
+        # block 0 poisons its softmax·V contraction (0 x NaN = NaN).
+        # Valid rows are bit-identical with or without the mask.
+        attn = ragged_paged_attention_xla(
             q, k_cache_l, v_cache_l, block_tables, context_lens, positions,
+            q_lens=tail_lens,
             sliding_window=(
                 window_l if alternating else cfg.sliding_window
             ),
@@ -802,6 +810,83 @@ def decode_loop(
         keys,
     )
     return tokens, k_cache, v_cache, ids
+
+
+def mixed_window(
+    params: dict,
+    cfg: MistralConfig,
+    # --- decode operands (identical to decode_loop) ---
+    input_ids: jnp.ndarray,  # [B] last emitted token per slot
+    positions: jnp.ndarray,  # [B]
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    context_lens: jnp.ndarray,  # [B]
+    steps_left: jnp.ndarray,  # [B] int32
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    min_p: jnp.ndarray,  # [B]
+    key: jax.Array,
+    # --- ragged prefill-chunk operands (prefill_paged shapes) ---
+    chunk_ids: jnp.ndarray,  # [C, S] uncached tail-span tokens (padded)
+    chunk_positions: jnp.ndarray,  # [C, S] absolute positions
+    chunk_block_tables: jnp.ndarray,  # [C, max_blocks]
+    chunk_context_lens: jnp.ndarray,  # [C] valid tokens incl. the span
+    chunk_tail_lens: jnp.ndarray,  # [C] valid tokens in chunk_ids (0 = pad)
+    chunk_temperature: jnp.ndarray,  # [C]
+    chunk_top_p: jnp.ndarray,  # [C]
+    chunk_min_p: jnp.ndarray,  # [C]
+    num_steps: int,
+    attn_backend: str = 'xla',
+    max_table_positions: int | None = None,
+    sampling_top_window: int = 0,
+    layer_unroll: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One MIXED serving window: ragged prefill-chunk rows + the fused
+    decode scan in a single dispatch (docs/serving.md).
+
+    The decode window streams every weight regardless of how many tokens
+    ride it, and on the serving tunnel each standalone prefill dispatch
+    between windows costs a full host round trip (~68 ms measured) — the
+    whole gap between the 830 tok/s serving loop and the 1101 tok/s
+    isolated window rate in round 5 (``probe_gen``, BENCH_NOTES_r05.md).
+    Folding the uncached prefill-tail chunks into the window dispatch
+    removes those round trips: the chunk rows' write-then-attend pass
+    (:func:`prefill_paged`, ragged per-row ``chunk_tail_lens`` — decode-
+    like rows of span 1 coexist with causal multi-token chunk rows) runs
+    first, then the unchanged decode scan. Chunk rows and decode rows own
+    disjoint KV blocks, so the fusion is value-exact: both halves compute
+    bit-identically to their standalone dispatches.
+
+    Returns ``(tokens [num_steps, B], k_cache, v_cache, last_ids,
+    chunk_tokens [C])`` where ``chunk_tokens`` samples each chunk row's
+    last valid position (meaningful only for rows that finish their tail
+    this window; the engine discards the rest). The key splits once into
+    (chunk, decode) streams, so stochastic draws differ from the pure
+    separate-prefill path — token identity versus that path is exact for
+    greedy (temperature 0) sampling, which is what the engine's identity
+    tests and the bench A/B pin.
+    """
+    from distllm_tpu.ops.sampling import sample_tokens
+
+    chunk_key, decode_key = jax.random.split(key)
+    chunk_logits, k_cache, v_cache = prefill_paged(
+        params, cfg, chunk_ids, chunk_positions, k_cache, v_cache,
+        chunk_block_tables, chunk_context_lens, chunk_tail_lens,
+        max_table_positions=max_table_positions,
+    )
+    chunk_tokens = sample_tokens(
+        chunk_logits, chunk_key, chunk_temperature, chunk_top_p,
+        chunk_min_p, top_window=sampling_top_window,
+    )
+    tokens, k_cache, v_cache, last_ids = decode_loop(
+        params, cfg, input_ids, positions, k_cache, v_cache, block_tables,
+        context_lens, steps_left, temperature, top_p, min_p, decode_key,
+        num_steps=num_steps, attn_backend=attn_backend,
+        max_table_positions=max_table_positions,
+        sampling_top_window=sampling_top_window, layer_unroll=layer_unroll,
+    )
+    return tokens, k_cache, v_cache, last_ids, chunk_tokens
 
 
 def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray:

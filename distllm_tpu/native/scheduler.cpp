@@ -203,15 +203,27 @@ int64_t sched_admit_next(void* h) {
 // rolled back (their requests sit in the waiting queue), so the caller must
 // read out_preempted[0..n_preempted) and sync its request states before
 // raising.
-int32_t sched_prepare_decode_k(void* h, int32_t k, int64_t* out_preempted) {
+// Row-filtered variant (mixed prefill+decode serving windows): only the
+// `n_rids` requests listed in `rids` are extended by k. Rows mid-prefill
+// inside a mixed window already own blocks for their full prompt from
+// admission, so giving them speculative decode headroom would waste pool
+// and provoke spurious preemptions. Preemption victims are still chosen
+// youngest-first over ALL running rows (a mid-prefill row may be
+// recompute-preempted; the engine resets its chunk progress).
+// rids == nullptr means "all running rows" (the classic policy).
+int32_t sched_prepare_decode_rows(void* h, int32_t k, const int64_t* rids,
+                                  int32_t n_rids, int64_t* out_preempted) {
     auto* s = static_cast<Scheduler*>(h);
     // INT32_MIN = argument error; must not collide with the fatal-
     // exhaustion encoding -(1 + n_preempted).
-    if (k < 1) return INT32_MIN;
+    if (k < 1 || n_rids < 0) return INT32_MIN;
     int32_t n_preempted = 0;
     std::vector<int64_t> snapshot(s->slots);
     for (int64_t rid : snapshot) {
         if (rid < 0) continue;
+        if (rids != nullptr &&
+            std::find(rids, rids + n_rids, rid) == rids + n_rids)
+            continue;  // not selected for decode this window
         Request& req = s->requests[rid];
         if (req.slot < 0) continue;  // preempted earlier in this loop
         bool preempted_self = false;
@@ -227,6 +239,10 @@ int32_t sched_prepare_decode_k(void* h, int32_t k, int64_t* out_preempted) {
         if (preempted_self) continue;
     }
     return n_preempted;
+}
+
+int32_t sched_prepare_decode_k(void* h, int32_t k, int64_t* out_preempted) {
+    return sched_prepare_decode_rows(h, k, nullptr, 0, out_preempted);
 }
 
 int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {

@@ -46,10 +46,13 @@ class FlightRecorder:
     """Bounded ring of per-step flight records (oldest evicted first).
 
     A record is one dict: ``{'kind': ..., 't_wall': ..., **fields}``.
-    ``kind`` is free-form but the engine uses ``'prefill'``, ``'decode'``,
-    ``'request'`` (lifecycle summary at finish), ``'preempt'``, and
-    ``'event'``. Appends are O(1) under a lock — safe from the engine
-    thread, the aiohttp event loop, and watchdog threads at once.
+    Every ``kind`` the package emits is registered in
+    ``instruments.FLIGHT_KINDS`` (``'prefill'``, ``'decode'``, ``'mixed'``
+    — a decode window carrying prefill-chunk rows — ``'request'``,
+    ``'preempt'``, ``'event'``; enforced by ``tests/test_lint.py`` so the
+    flight schema cannot fragment). Appends are O(1) under a lock — safe
+    from the engine thread, the aiohttp event loop, and watchdog threads
+    at once.
     """
 
     def __init__(self, capacity: int = 4096) -> None:

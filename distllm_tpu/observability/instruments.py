@@ -115,6 +115,29 @@ ENGINE_PREFILL_CHUNK_TOKENS = _registry.histogram(
     buckets=(16, 32, 64, 128, 256, 512, 1024, 2048),
 )
 
+# ------------------------------------------- mixed prefill+decode windows
+MIXED_WINDOWS = _registry.counter(
+    'distllm_engine_mixed_windows_total',
+    'Decode-window dispatches that also carried prefill-chunk rows '
+    '(EngineConfig.enable_mixed_batching; docs/serving.md).',
+)
+MIXED_PREFILL_TOKENS = _registry.counter(
+    'distllm_engine_mixed_prefill_tokens_total',
+    'Prefill-tail chunk tokens that rode decode windows instead of '
+    'standalone prefill dispatches.',
+)
+MIXED_PREFILL_TOKENS_PER_WINDOW = _registry.histogram(
+    'distllm_engine_mixed_prefill_tokens_per_window',
+    'Valid prefill-chunk tokens folded into one mixed window '
+    '(bounded by EngineConfig.max_window_prefill_tokens).',
+    buckets=(1, 16, 32, 64, 128, 256, 512, 1024, 2048),
+)
+MIXED_PREFILL_ROWS = _registry.histogram(
+    'distllm_engine_mixed_prefill_rows',
+    'Prefill-chunk rows (requests) folded into one mixed window.',
+    buckets=(1, 2, 4, 8),
+)
+
 # ------------------------------------------------- request lifecycle (SLO)
 REQUEST_TTFT = _registry.histogram(
     'distllm_request_ttft_seconds',
@@ -145,22 +168,37 @@ GOODPUT_TOKENS = _registry.counter(
 ENGINE_STEPS = _registry.counter(
     'distllm_engine_steps_total',
     'Engine steps recorded by the flight recorder, by kind '
-    '(prefill/decode).',
+    '(prefill/decode/mixed).',
     labelnames=('kind',),
 )
 ENGINE_STEP_SECONDS = _registry.histogram(
     'distllm_engine_step_duration_seconds',
     'Wall time per engine step, by kind: prefill = host-side dispatch of '
-    'one padded prefill; decode = dispatch -> host fetch of one fused '
-    'window (includes pipelined in-flight time).',
+    'one padded prefill; decode/mixed = dispatch -> host fetch of one '
+    'fused window (includes pipelined in-flight time).',
     labelnames=('kind',),
 )
 
 # Pre-create the fixed label sets so the full request-lifecycle schema is
 # present in the very first scrape, before any traffic.
-for _kind in ('prefill', 'decode'):
+for _kind in ('prefill', 'decode', 'mixed'):
     ENGINE_STEPS.labels(kind=_kind)
     ENGINE_STEP_SECONDS.labels(kind=_kind)
+
+# Catalog of FlightRecorder record kinds, mirroring the distllm_* metric-
+# name catalog above: every ``kind`` the package ever passes to
+# ``FlightRecorder.record`` / the engine's ``_record_step`` must be listed
+# here (enforced by tests/test_lint.py). A kind minted at a call site
+# would silently fragment the flight schema that debug bundles,
+# ``/debug/flight``, and ``aggregate.py`` replay.
+FLIGHT_KINDS = frozenset({
+    'prefill',  # one padded prefill dispatch (batched or paged-context)
+    'decode',   # one fused decode window, dispatch -> host fetch
+    'mixed',    # decode window that also carried prefill-chunk rows
+    'request',  # per-request lifecycle summary at finish
+    'preempt',  # recompute preemption performed by prepare_decode
+    'event',    # rare irregular events (scheduler exhaustion, ...)
+})
 for _outcome in ('met', 'missed'):
     REQUEST_SLO.labels(outcome=_outcome)
 

@@ -114,27 +114,35 @@ def paged_attention_xla(
     return out.reshape(b, num_heads, head_dim).astype(q.dtype)
 
 
-def paged_prefill_attention_xla(
-    q: jnp.ndarray,  # [B, S, num_heads, head_dim] tail queries
+def ragged_paged_attention_xla(
+    q: jnp.ndarray,  # [B, S, num_heads, head_dim] per-row query spans
     k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
-    context_lens: jnp.ndarray,  # [B] total valid tokens incl. the tail
+    context_lens: jnp.ndarray,  # [B] total valid tokens incl. the span
     q_positions: jnp.ndarray,  # [B, S] absolute position of each query
+    q_lens: 'jnp.ndarray | None' = None,  # [B] valid queries per row
     sliding_window: 'int | jnp.ndarray | None' = None,
     scale: float | None = None,
     logit_softcap: float | None = None,
 ) -> jnp.ndarray:
-    """Multi-query attention over paged KV: the prefix-cache / chunked
-    prefill kernel (tail queries attend to cached history + themselves).
+    """Ragged per-row-query-length attention over paged KV — the shared
+    kernel of prefix-cache tail prefill, chunked prefill, and mixed
+    prefill+decode serving windows (docs/serving.md).
 
-    The multi-token sibling of :func:`paged_attention_xla`: each of the
-    ``S`` tail queries per sequence attends to every cached position
-    ``<=`` its own absolute position (the tail's K/V must already be
-    written into the paged blocks — the model writes before attending,
-    exactly like the decode path). Gather + masked fp32 softmax; XLA
-    fuses this well and it runs on CPU for tests. Prefill is compute-
-    bound, so unlike decode there is no Pallas variant.
+    Each row carries a SPAN of queries at absolute ``q_positions``; every
+    query attends to all cached positions ``<=`` its own (the span's K/V
+    must already be written into the paged blocks — write-then-attend,
+    exactly like the decode path). Rows are ragged: a decode row is a
+    span of length 1 (its single query sees the whole context, 1-vs-
+    context — numerically the :func:`paged_attention_xla` result), while
+    a prefill-chunk row's queries attend causally over chunk + paged
+    prefix. ``q_lens`` (optional) masks each row's padding queries so
+    their softmax rows stay finite; with ``q_lens=None`` padding queries
+    compute garbage the caller discards (masking only touches pad rows —
+    valid rows are bit-identical either way). Gather + masked fp32
+    softmax; XLA fuses this well and it runs on CPU for tests. Prefill
+    spans are compute-bound, so unlike decode there is no Pallas variant.
     """
     b, s, num_heads, head_dim = q.shape
     _, block_size, num_kv_heads, _ = k_cache.shape
@@ -168,10 +176,41 @@ def paged_prefill_attention_xla(
             valid = valid & windowed
         else:
             valid = valid & (windowed | (sliding_window <= 0))
+    if q_lens is not None:
+        # Padding queries keep key 0 visible: an all-masked softmax row is
+        # NaN, and a NaN in a pad row can poison reductions downstream.
+        q_valid = jnp.arange(s)[None, :, None] < q_lens[:, None, None]
+        valid = valid | (~q_valid & (kv_pos == 0))
     scores = jnp.where(valid[:, None, None, :, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum('bkgst,btkd->bskgd', probs, v.astype(jnp.float32))
     return out.reshape(b, s, num_heads, head_dim).astype(q.dtype)
+
+
+def paged_prefill_attention_xla(
+    q: jnp.ndarray,  # [B, S, num_heads, head_dim] tail queries
+    k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    context_lens: jnp.ndarray,  # [B] total valid tokens incl. the tail
+    q_positions: jnp.ndarray,  # [B, S] absolute position of each query
+    sliding_window: 'int | jnp.ndarray | None' = None,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
+) -> jnp.ndarray:
+    """Multi-query attention over paged KV: prefix-cache / chunked prefill
+    tail queries attending to cached history + themselves.
+
+    Now a thin alias of :func:`ragged_paged_attention_xla` (every tail row
+    is a ragged span; ``q_lens`` stays ``None`` so the emitted HLO — and
+    bit pattern — is unchanged from the pre-ragged op; padding-row logits
+    are garbage the caller discards).
+    """
+    return ragged_paged_attention_xla(
+        q, k_cache, v_cache, block_tables, context_lens, q_positions,
+        q_lens=None, sliding_window=sliding_window, scale=scale,
+        logit_softcap=logit_softcap,
+    )
 
 
 def _paged_attn_kernel(
@@ -428,11 +467,13 @@ def write_chunk_kv(
     positions: jnp.ndarray,  # [B, S] absolute position per tail token
     valid: jnp.ndarray,  # [B, S] bool — padding rows/tokens route to trash
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Scatter a batch of tail chunks' K/V into their paged blocks.
+    """Scatter a batch of ragged spans' K/V into their paged blocks.
 
-    The multi-token sibling of :func:`write_token_kv` (prefix-cache tail
-    prefill / chunked prefill): invalid positions write to the reserved
-    trash block 0 — same pad-safety contract as :func:`write_prefill_kv`.
+    The multi-token sibling of :func:`write_token_kv` and the write half
+    of the ragged path (prefix-cache tail prefill, chunked prefill, and
+    chunk rows riding mixed serving windows): ``valid`` carries the
+    per-row raggedness — invalid positions write to the reserved trash
+    block 0, the same pad-safety contract as :func:`write_prefill_kv`.
     """
     block_size = k_cache.shape[1]
     b, s = positions.shape

@@ -320,3 +320,20 @@ def test_generation_config_eos_fallback(tmp_path):
     for bad in ('not json', '[1, 2]', '{"eos_token_id": "<eos>"}'):
         (tmp_path / 'generation_config.json').write_text(bad)
         assert _generation_config_eos(tmp_path) == ()
+
+
+def test_tpu_generator_config_mixed_batching_knobs():
+    """Serving configs can opt into mixed prefill+decode windows; None
+    defaults inherit EngineConfig's single-owner defaults."""
+    from distllm_tpu.generate.generators.tpu_backend import TpuGeneratorConfig
+
+    cfg = TpuGeneratorConfig(
+        pretrained_model_name_or_path='/x',
+        enable_mixed_batching=True,
+        max_window_prefill_tokens=128,
+    )
+    assert cfg.enable_mixed_batching is True
+    assert cfg.max_window_prefill_tokens == 128
+    default = TpuGeneratorConfig(pretrained_model_name_or_path='/x')
+    assert default.enable_mixed_batching is None
+    assert default.max_window_prefill_tokens is None

@@ -217,6 +217,77 @@ def test_metric_names_registered_in_catalog():
     )
 
 
+def _flight_kind_catalog() -> set[str]:
+    """Flight-record kinds registered in ``instruments.FLIGHT_KINDS``
+    (AST-extracted, mirroring the metric-name catalog parser)."""
+    tree = ast.parse(
+        (REPO / 'distllm_tpu' / 'observability' / 'instruments.py').read_text()
+    )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Name) and tgt.id == 'FLIGHT_KINDS'):
+                continue
+            call = node.value  # frozenset({...})
+            if isinstance(call, ast.Call) and call.args:
+                return {
+                    el.value
+                    for el in getattr(call.args[0], 'elts', [])
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)
+                }
+    return set()
+
+
+def test_flight_record_kinds_registered_in_catalog():
+    """Every FlightRecorder ``kind`` emitted in the package (a string
+    literal — or a conditional between string literals — as the first
+    argument of a ``.record(...)`` / ``_record_step(...)`` call) must be
+    registered in the ``instruments.FLIGHT_KINDS`` catalog, mirroring the
+    ``distllm_*`` metric-name rule. A kind minted at a call site would
+    silently fragment the flight schema that debug bundles,
+    ``/debug/flight``, and ``aggregate.py`` replay."""
+    registered = _flight_kind_catalog()
+    assert registered, 'FLIGHT_KINDS parse came back empty — rule is broken'
+    offenders = []
+    for path in sorted((REPO / 'distllm_tpu').rglob('*.py')):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            name = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name)
+                else None
+            )
+            if name not in ('record', '_record_step'):
+                continue
+            first = node.args[0]
+            branches = (
+                (first.body, first.orelse)
+                if isinstance(first, ast.IfExp)
+                else (first,)
+            )
+            for branch in branches:
+                if not (
+                    isinstance(branch, ast.Constant)
+                    and isinstance(branch.value, str)
+                ):
+                    continue
+                if branch.value not in registered:
+                    offenders.append(
+                        f'{path.relative_to(REPO)}:{node.lineno} '
+                        f'{branch.value}'
+                    )
+    assert not offenders, (
+        'flight-record kinds not registered in instruments.FLIGHT_KINDS '
+        '(add them there — the catalog is the flight-schema contract):\n'
+        + '\n'.join(sorted(set(offenders)))
+    )
+
+
 @pytest.mark.skipif(shutil.which('ruff') is None, reason='ruff not installed')
 def test_ruff():
     proc = subprocess.run(

@@ -59,13 +59,28 @@ def drive(sched, seed: int, steps: int = 200):
             if sched.num_running:
                 # k > 1 exercises the multi-step window reservation path.
                 k = int(rng.integers(1, 6))
+                # Half the time restrict to a random running subset — the
+                # mixed-serving-window path (rows mid-prefill get no
+                # decode headroom); None = classic all-rows policy.
+                rids = None
+                if rng.integers(0, 2):
+                    rids = [
+                        rid for rid in sorted(live)
+                        if sched.slot(rid) >= 0 and rng.integers(0, 2)
+                    ]
                 try:
-                    preempted = sched.prepare_decode(k)
+                    preempted = sched.prepare_decode(k, rids)
                 except SchedulerExhausted as exc:
                     # Fatal path reports prior same-call preemptions too;
                     # both implementations must agree on them.
                     preempted = ['EXHAUSTED', tuple(exc.preempted)]
-                trace.append(('prepare', k, tuple(preempted)))
+                trace.append(
+                    (
+                        'prepare', k,
+                        tuple(rids) if rids is not None else None,
+                        tuple(preempted),
+                    )
+                )
                 for rid in list(live):
                     if sched.slot(rid) >= 0:
                         sched.append_token(rid)
@@ -247,3 +262,38 @@ class TestPrepareDecodeK:
         sched = sched_factory(num_blocks=8, block_size=4, max_num_seqs=2)
         with pytest.raises(ValueError):
             sched.prepare_decode(0)
+
+    def test_rows_filter_extends_only_selected(self, sched_factory):
+        """Mixed serving windows: rows mid-prefill ride the window but
+        take no decode steps, so prepare_decode(k, rids) must grant the
+        k-token headroom only to the listed rows."""
+        sched = sched_factory(num_blocks=16, block_size=4, max_num_seqs=3)
+        sched.add(0, 4)
+        sched.add(1, 4)
+        assert sched.admit_next() == 0
+        assert sched.admit_next() == 1
+        free_before = sched.num_free_blocks
+        assert sched.prepare_decode(8, [0]) == []
+        assert len(sched.block_row(0)) == 3  # ceil((4+8)/4)
+        assert len(sched.block_row(1)) == 2  # untouched
+        assert sched.num_free_blocks == free_before - 1
+        # Empty selection is a no-op (chunk-only windows never call this,
+        # but the contract must hold).
+        assert sched.prepare_decode(8, []) == []
+        assert sched.num_free_blocks == free_before - 1
+
+    def test_rows_filter_can_preempt_unselected_victim(self, sched_factory):
+        """Victims are still chosen youngest-first over ALL running rows:
+        a mid-prefill (unselected) youngest can be recompute-preempted to
+        fund a decode-ready row's reservation."""
+        sched = sched_factory(num_blocks=8, block_size=4, max_num_seqs=2)
+        sched.add(0, 4)
+        sched.add(1, 4)
+        assert sched.admit_next() == 0
+        assert sched.admit_next() == 1
+        # 7 usable; each owns 2, 3 free. Row 0 reserving 20 more tokens
+        # needs ceil(24/4)=6 blocks (+4): only preempting row 1 funds it.
+        preempted = sched.prepare_decode(20, [0])
+        assert preempted == [1]
+        assert sched.slot(1) == -1
+        assert len(sched.block_row(0)) == 6
