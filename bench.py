@@ -32,9 +32,9 @@ record and a global wall-clock deadline:
   composed from whatever the run record holds — so an external kill still
   publishes every completed stage;
 - stages run cheapest-first (embed → embed_q → gen → gen_prefix →
-  gen_mixed → gen_q: embed warmups are minutes, ``gen_prefix``/
-  ``gen_mixed`` reuse ``gen``'s compile cache, and int8 ``gen_q``'s cold
-  warmup — 22–45 min in round 4 — goes last);
+  gen_mixed → gen_spec → gen_q: embed warmups are minutes, ``gen_prefix``/
+  ``gen_mixed``/``gen_spec`` reuse ``gen``'s compile cache, and int8
+  ``gen_q``'s cold warmup — 22–45 min in round 4 — goes last);
 - a failing or SIGTERM'd stage dumps a debug bundle (flight ring, metrics,
   traces — ``observability.dump_debug_bundle``) so a dead stage still
   explains itself, and gen stages run under a ``StallWatchdog``.
@@ -804,6 +804,176 @@ def _stage_gen_mixed() -> dict:
     return out
 
 
+def _stage_gen_spec() -> dict:
+    """Prompt-lookup speculative decoding A/B (docs/speculative.md): the
+    SAME staggered greedy workload through three arms — the classic
+    decode scan (``draft_k=0``), verify windows with drafting disabled
+    (``spec_draft_source='none'``), and full speculation.
+
+    The contract this stage checks and records:
+
+    - drafting on vs off INSIDE the verify kernel is BIT-IDENTICAL
+      (``tokens_identical`` — same compiled executable, so this holds in
+      bf16; a mismatch means the acceptance rule or rollback is broken
+      and the stage records an error);
+    - agreement with the classic decode-scan arm is recorded as
+      ``tokens_match_decode_path``: guaranteed only in fp32 — two
+      compiled programs may round a near-tied bf16 logit differently
+      (measured: a 3.9e-3 top-2 gap flipped on CPU smoke), the same
+      reason vLLM does not promise bitwise spec parity — so it is
+      evidence, not an assert;
+    - ``gen_spec_accept_rate`` — accepted / drafted tokens, the
+      speculative win in one number (every accepted token skipped its
+      weight pass) — and tok/s for all arms, comparable to
+      ``gen_tok_per_s``;
+    - verify windows actually ran (``spec_windows`` > 0).
+
+    ``DISTLLM_BENCH_SPEC=0`` skips the stage (default on). The workload
+    is greedy (speculation is greedy-only) and deliberately repetitive —
+    shared prefixes plus prompts that repeat an n-gram motif, the
+    RAG-quote/MCQA-stem shape prompt lookup exploits.
+    """
+    import jax
+    import numpy as np
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.models import mistral
+
+    prefix = 'gen_spec_'
+    if os.environ.get('DISTLLM_BENCH_SPEC', '1') in ('', '0'):
+        return {f'{prefix}skipped': 'DISTLLM_BENCH_SPEC=0'}
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+        )
+        max_num_seqs, num_blocks = 4, 160
+        n_prompts, prompt_lo, prompt_hi = 12, 8, 48
+        out_lo, out_hi, draft_k = 4, 24, 4
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+        max_num_seqs, num_blocks = 32, 712
+        n_prompts, prompt_lo, prompt_hi = 64, 32, 192
+        out_lo, out_hi, draft_k = 16, 96, 4
+
+    rng = np.random.default_rng(0)
+    shared = list(rng.integers(1, model_cfg.vocab_size, size=32))
+    motif = list(rng.integers(1, model_cfg.vocab_size, size=8))
+    prompts = []
+    for i, n in enumerate(rng.integers(prompt_lo, prompt_hi, size=n_prompts)):
+        tail = list(rng.integers(1, model_cfg.vocab_size, size=int(n)))
+        if i % 2 == 0:
+            # Tile the motif through the tail so the prompt itself holds
+            # repeated n-grams — prompt-lookup's draft material.
+            tail = (motif * (1 + len(tail) // len(motif)))[: len(tail)]
+        prompts.append(shared + tail if i % 3 == 0 else tail)
+    budgets = [int(n) for n in rng.integers(out_lo, out_hi, size=n_prompts)]
+
+    def run_arm(k: int, source: str = 'prompt_lookup') -> dict:
+        engine_cfg = EngineConfig(
+            block_size=16,
+            num_blocks=num_blocks,
+            max_num_seqs=max_num_seqs,
+            max_model_len=512,
+            decode_steps=16,
+            pipeline_depth=2,
+            sampling_top_window=64,
+            enable_prefix_cache=True,
+            draft_k=k,
+            spec_draft_source=source,
+        )
+        engine, fallback_reason = _build_engine_with_fallback(
+            model_cfg,
+            engine_cfg,
+            lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+            [[1, 2, 3]],
+            SamplingParams(temperature=0.0, max_tokens=2),
+        )
+        rids = [
+            engine.add_request(
+                p, SamplingParams(temperature=0.0, max_tokens=n)
+            )
+            for p, n in zip(prompts, budgets)
+        ]
+        start = time.perf_counter()
+        seen: dict = {rid: [] for rid in rids}
+        while engine.has_unfinished:
+            for rid, tok in engine.step():
+                seen[rid].append(tok)
+        elapsed = time.perf_counter() - start
+        n_tokens = sum(len(v) for v in seen.values())
+        drafted = int(engine._stats.get('spec_draft_tokens', 0))
+        accepted = int(engine._stats.get('spec_accepted_tokens', 0))
+        arm = {
+            'tokens': [seen[rid] for rid in rids],
+            'throughput_tok_s': round(n_tokens / elapsed, 2),
+            'spec_windows': int(engine._stats.get('spec_windows', 0)),
+            'draft_tokens': drafted,
+            'accepted_tokens': accepted,
+            'accept_rate': round(accepted / drafted, 4) if drafted else None,
+            'fallback_reason': fallback_reason,
+        }
+        engine.shutdown()
+        return arm
+
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
+    classic = run_arm(0)
+    null = run_arm(draft_k, source='none')
+    on = run_arm(draft_k)
+    warmup_secs = time.perf_counter() - warmup_start
+    identical = on['tokens'] == null['tokens']
+    matches_decode = on['tokens'] == classic['tokens']
+    out = {
+        f'{prefix}metric': 'speculative-decoding A/B',
+        f'{prefix}tokens_identical': identical,
+        f'{prefix}tokens_match_decode_path': matches_decode,
+        f'{prefix}tok_per_s': on['throughput_tok_s'],
+        f'{prefix}off_tok_per_s': classic['throughput_tok_s'],
+        f'{prefix}nodraft_tok_per_s': null['throughput_tok_s'],
+        f'{prefix}accept_rate': on['accept_rate'],
+        f'{prefix}windows': on['spec_windows'],
+        f'{prefix}draft_tokens': on['draft_tokens'],
+        f'{prefix}accepted_tokens': on['accepted_tokens'],
+        f'{prefix}draft_k': draft_k,
+        f'{prefix}elapsed_all_arms_s': round(warmup_secs, 1),
+        f'{prefix}workload': _workload_fingerprint(
+            {'prompts': [list(map(int, p)) for p in prompts],
+             'budgets': budgets,
+             'engine': {'max_num_seqs': max_num_seqs,
+                        'num_blocks': num_blocks,
+                        'draft_k': draft_k}}
+        ),
+        **_cache_fields(prefix, cache_before),
+    }
+    if not identical:
+        out[f'{prefix}error'] = (
+            'speculation on/off token mismatch inside the verify kernel '
+            '— the acceptance/rollback identity contract is broken'
+        )
+    elif on['spec_windows'] == 0:
+        # Without verify windows the spec arms silently degenerate to the
+        # classic path and every assertion above passes vacuously.
+        out[f'{prefix}error'] = (
+            'no speculative verify windows ran — draft_k routing is '
+            'broken or the workload never decoded'
+        )
+    if not matches_decode:
+        # Expected occasionally in bf16 (near-tie rounding across two
+        # compiled programs, see the stage docstring); never in fp32.
+        out[f'{prefix}decode_path_note'] = (
+            'spec stream diverged from the classic decode-scan stream: '
+            'bf16 near-tie across kernels (docs/speculative.md), not an '
+            'acceptance bug — tokens_identical is the contract assert'
+        )
+    if on['fallback_reason'] or classic['fallback_reason']:
+        out[f'{prefix}attn_fallback_reason'] = (
+            on['fallback_reason'] or classic['fallback_reason']
+        )
+    return out
+
+
 def _stage_gen() -> dict:
     return _run_gen(None, 'gen_')
 
@@ -840,16 +1010,19 @@ def _chip_peak_flops(device) -> float | None:
 # compile cache (same bf16 7B dims), and int8 gen_q's cold warmup — the
 # round-4 22-45 min outlier — runs last so a deadline truncates the most
 # expensive coverage first, never the headline metrics.
-STAGE_ORDER = ('embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_q')
+STAGE_ORDER = (
+    'embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_spec', 'gen_q',
+)
 NOMINAL_BUDGET_S = {
     'embed': 1200.0,
     'embed_q': 1200.0,
     'gen': 2700.0,
     'gen_prefix': 2700.0,
     'gen_mixed': 2700.0,
+    'gen_spec': 2700.0,
     'gen_q': 2700.0,
 }
-GEN_STAGES = frozenset({'gen', 'gen_q', 'gen_prefix', 'gen_mixed'})
+GEN_STAGES = frozenset({'gen', 'gen_q', 'gen_prefix', 'gen_mixed', 'gen_spec'})
 # Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
 # stages stop with ~5 min to spare even if the guess is exact, and the
 # SIGTERM handler is the backstop if the real budget is shorter.
@@ -1079,6 +1252,7 @@ def _run_stage_entry(stage: str) -> None:
         'gen_q': _stage_gen_q,
         'gen_prefix': _stage_gen_prefix,
         'gen_mixed': _stage_gen_mixed,
+        'gen_spec': _stage_gen_spec,
     }
     watchdog = None
     watchdog_s = float(os.environ.get('DISTLLM_BENCH_WATCHDOG_S', '300') or 0)
@@ -1103,6 +1277,7 @@ def main() -> None:
         '--stage',
         choices=[
             'embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix', 'gen_mixed',
+            'gen_spec',
         ],
     )
     args = parser.parse_args()

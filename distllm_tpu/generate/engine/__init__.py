@@ -8,11 +8,13 @@ from distllm_tpu.generate.engine.engine import (
     SamplingParams,
 )
 from distllm_tpu.generate.engine.kv_cache import PagedKVCache
+from distllm_tpu.generate.engine.spec import PromptLookupDrafter
 
 __all__ = [
     'EngineConfig',
     'LLMEngine',
     'PagedKVCache',
+    'PromptLookupDrafter',
     'Request',
     'RequestState',
     'SamplingParams',

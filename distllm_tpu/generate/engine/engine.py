@@ -110,6 +110,12 @@ class Request:
     prefill_target: int = 0
     prefill_sent: int = 0
     prefill_done: int = 0
+    # --- prompt-lookup speculative decoding (docs/speculative.md) ---
+    # Per-request n-gram drafter (None = this row never drafts: draft_k
+    # is 0 or the request samples with temperature > 0). The drafter's
+    # index covers prompt+output history, which recompute preemption
+    # preserves, so it survives preemption untouched.
+    drafter: 'object | None' = None
     # --- lifecycle timestamps (flight recorder, docs/observability.md) ---
     # monotonic seconds; 0.0 = not reached. t_admit/t_first_token keep
     # their FIRST value across recompute preemption: the client-visible
@@ -172,12 +178,19 @@ class EngineConfig(BaseConfig):
 
     @field_validator(
         'sampling_top_window', 'prefill_chunk_tokens',
-        'max_window_prefill_tokens',
+        'max_window_prefill_tokens', 'draft_k',
     )
     @classmethod
     def _non_negative_window(cls, v: int, info) -> int:
         if v < 0:
             raise ValueError(f'{info.field_name} must be >= 0')
+        return v
+
+    @field_validator('spec_ngram')
+    @classmethod
+    def _ngram_at_least_one(cls, v: int, info) -> int:
+        if v < 1:
+            raise ValueError(f'{info.field_name} must be >= 1')
         return v
 
     @field_validator('max_window_prefill_seqs')
@@ -219,6 +232,18 @@ class EngineConfig(BaseConfig):
                 'enable_mixed_batching needs enable_prefix_cache and/or '
                 'prefill_chunk_tokens: only cache-hit tails and chunked '
                 'spans ride mixed windows (docs/serving.md)'
+            )
+        if self.draft_k and self.defer_prefill:
+            # Speculative windows process synchronously (the prompt-lookup
+            # drafter needs the host-fetched history before it can propose
+            # the next span), so there is never an in-flight deque for
+            # deferred first tokens to ride — the combination would leave
+            # carried-ids scatters that are fetched nowhere.
+            raise ValueError(
+                'draft_k and defer_prefill are mutually exclusive: '
+                'speculative windows fetch every window synchronously '
+                '(the drafter needs host-side history), which removes '
+                "defer_prefill's in-flight deque (docs/speculative.md)"
             )
         return self
     # Automatic prefix caching (docs/prefix_caching.md): full prompt
@@ -276,7 +301,46 @@ class EngineConfig(BaseConfig):
     # on TPU every extra mixed shape is another multi-minute unrolled-
     # window compile at warmup (see docs/serving.md).
     max_window_prefill_seqs: int = 2
+    # Prompt-lookup speculative decoding (docs/speculative.md): up to
+    # draft_k tokens per row are proposed from the row's OWN prompt+output
+    # history and verified in ONE ragged dispatch (per-row spans of
+    # 1 + draft_k through the same write-then-attend kernel as paged
+    # prefill), so every accepted draft token is a decode token that
+    # skipped its weight pass. Greedy output with speculation on is
+    # token-identical to speculation off (tested across the full engine
+    # identity matrix); rows with temperature > 0 fall back to span 1 —
+    # no drafting — because acceptance compares against the row's OWN
+    # sampled token, which is only deterministic under greedy.
+    # 0 disables speculation entirely (the classic decode-scan windows).
+    # Speculative windows process synchronously (the drafter needs the
+    # host-fetched history), so pipeline_depth is effectively 1 while
+    # draft_k > 0: the trade is dispatch-latency hiding for weight-pass
+    # skipping, which wins at the low-batch/low-latency end where decode
+    # is weight-stream-bound.
+    draft_k: int = 0
+    # n-gram length the prompt-lookup drafter matches on. Longer n-grams
+    # propose less often but more precisely.
+    spec_ngram: int = 2
+    # Where drafts come from. 'prompt_lookup' is the real drafter;
+    # 'none' proposes nothing — every window is a span-1 verify dispatch
+    # through the SAME compiled executable, which makes it the
+    # bit-identity baseline for speculation A/Bs in bf16: two compiled
+    # programs (the decode scan vs the ragged verify) may round a
+    # near-tied logit differently, so cross-KERNEL token identity is
+    # only guaranteed in fp32, while drafting-on vs drafting-off inside
+    # the verify kernel is bit-identical in any dtype
+    # (docs/speculative.md; the gen_spec bench stage asserts it).
+    spec_draft_source: str = 'prompt_lookup'
     seed: int = 0
+
+    @field_validator('spec_draft_source')
+    @classmethod
+    def _known_draft_source(cls, v: str) -> str:
+        if v not in ('prompt_lookup', 'none'):
+            raise ValueError(
+                "spec_draft_source must be 'prompt_lookup' or 'none'"
+            )
+        return v
 
 
 class LLMEngine:
@@ -501,6 +565,48 @@ class LLMEngine:
             if cfg.enable_mixed_batching
             else None
         )
+
+        # Speculative verify windows (docs/speculative.md): one ragged
+        # dispatch scores every row's [last_token, drafts...] span. Two
+        # variants — plain, and chunk-carrying (mixed batching): the
+        # chunk tuple is pytree-static, so each compiles its own graph
+        # and a pure-spec deployment never compiles the chunk shapes.
+        def spec_fn(
+            params, ids, pos, ctx, k, v, bt, tails, temp, top_p, min_p, key,
+        ):
+            return mistral.spec_window(
+                params, model, ids, pos, k, v, bt, ctx, tails,
+                temp, top_p, min_p, key,
+                max_table_positions=max_tables,
+                sampling_top_window=cfg.sampling_top_window,
+            )
+
+        def spec_mixed_fn(
+            params, ids, pos, ctx, k, v, bt, tails, temp, top_p, min_p,
+            key, c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp, c_top_p,
+            c_min_p,
+        ):
+            return mistral.spec_window(
+                params, model, ids, pos, k, v, bt, ctx, tails,
+                temp, top_p, min_p, key,
+                chunk=(
+                    c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp, c_top_p,
+                    c_min_p,
+                ),
+                max_table_positions=max_tables,
+                sampling_top_window=cfg.sampling_top_window,
+            )
+
+        self._spec_fn = spec_fn
+        self._spec_mixed_fn = spec_mixed_fn
+        self._spec_window = (
+            jax.jit(spec_fn, donate_argnums=(4, 5)) if cfg.draft_k else None
+        )
+        self._spec_mixed_window = (
+            jax.jit(spec_mixed_fn, donate_argnums=(4, 5))
+            if cfg.draft_k and cfg.enable_mixed_batching
+            else None
+        )
         # Resolved-at-serve-time values: a config that believes it enabled
         # the Pallas kernel can otherwise ship 3x slower with no signal.
         self.telemetry: dict[str, str] = {'attn_backend': attn_backend}
@@ -531,6 +637,7 @@ class LLMEngine:
                 self.params = self._migrate_params(formats)
                 self._decode_window = compiled
                 self._pin_mixed_layout(formats)
+                self._pin_spec_layout(formats)
         self.kv.allocate()
         # Merge host-known overrides (fresh admissions) into the device-
         # carried last-token vector between pipelined windows.
@@ -740,6 +847,35 @@ class LLMEngine:
         except Exception as exc:  # pragma: no cover - TPU-only path
             self.telemetry['mixed_layout_fallback'] = repr(exc)[:300]
 
+    def _pin_spec_layout(self, formats) -> None:
+        """Re-jit the speculative windows with params pinned to the
+        migrated layouts (the mixed-window rationale applies unchanged:
+        a default-layout lazy compile would bury multi-GiB relayout
+        copies inside every verify dispatch)."""
+        if self._spec_window is None:
+            return
+        try:  # pragma: no cover - TPU-only path
+            from jax.experimental.layout import Format
+            from jax.sharding import SingleDeviceSharding
+
+            sharding = SingleDeviceSharding(jax.devices()[0])
+            pinned = jax.tree.map(
+                lambda fmt: Format(fmt.layout, sharding), formats
+            )
+            self._spec_window = jax.jit(
+                self._spec_fn,
+                donate_argnums=(4, 5),
+                in_shardings=(pinned,) + (Format(),) * 11,
+            )
+            if self._spec_mixed_window is not None:
+                self._spec_mixed_window = jax.jit(
+                    self._spec_mixed_fn,
+                    donate_argnums=(4, 5),
+                    in_shardings=(pinned,) + (Format(),) * 19,
+                )
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            self.telemetry['spec_layout_fallback'] = repr(exc)[:300]
+
     def warmup(self) -> None:
         """Compile every serving shape outside the request path.
 
@@ -843,8 +979,16 @@ class LLMEngine:
             self._put(np.zeros((bsz,), bool)),
             self._put(np.zeros((bsz,), np.int32)),
         )
-        if self._mixed_window is not None:
-            # Warm every mixed-window shape the chunk planner can emit:
+        if self._mixed_window is not None and not self.config.draft_k:
+            # Warm every mixed-window shape the chunk planner can emit
+            # — but NOT in speculative mode: _dispatch_window then always
+            # routes to spec windows, so the classic mixed executable is
+            # structurally unreachable and each of its bucket shapes
+            # would be a multi-minute unrolled-window compile for
+            # nothing (chunk traffic rides _spec_mixed_window, warmed
+            # below). The jit object still exists — _plan_window_chunks
+            # uses it as the mixed-enabled signal — it is just never
+            # compiled.
             # rows always pad to the pow2 of max_window_prefill_seqs, so
             # only the chunk-token bucket varies (ladder capped at the
             # window budget). tail_lens 0 + all-zero tables route every
@@ -883,6 +1027,70 @@ class LLMEngine:
                     self._put(np.zeros((cb,), np.float32)),
                 )
                 np.asarray(mixed_tokens)
+        if self._spec_window is not None:
+            # Warm the speculative verify window: ONE fixed span shape
+            # [B, 1 + draft_k] (rows with shorter drafts pad via
+            # span_lens, so the span dim never adds compiled shapes).
+            # span_lens 0 + all-zero tables route every write to the
+            # trash block; logits/tokens are garbage the host discards.
+            span = 1 + self.config.draft_k
+            spec_tokens, self.kv.k, self.kv.v, _ = self._spec_window(
+                self.params,
+                self._put(np.zeros((bsz, span), np.int32)),
+                self._put(np.zeros((bsz, span), np.int32)),
+                self._put(np.ones((bsz,), np.int32)),
+                self.kv.k,
+                self.kv.v,
+                self._put(np.zeros((bsz, self.max_blocks_per_seq), np.int32)),
+                self._put(np.zeros((bsz,), np.int32)),
+                self._put(np.zeros((bsz,), np.float32)),
+                self._put(np.ones((bsz,), np.float32)),
+                self._put(np.zeros((bsz,), np.float32)),
+                jax.random.PRNGKey(0),
+            )
+            np.asarray(spec_tokens)
+        if self._spec_mixed_window is not None:
+            # Chunk-carrying spec windows: the same chunk-bucket ladder
+            # the mixed warmup walks, beside the fixed spec span.
+            span = 1 + self.config.draft_k
+            cb = self._mixed_rows()
+            span_bucket = pick_bucket(
+                self._mixed_span_cap(), self.prefill_buckets
+            )
+            for bucket in self.prefill_buckets:
+                if bucket > span_bucket:
+                    break
+                spec_tokens, self.kv.k, self.kv.v, _ = (
+                    self._spec_mixed_window(
+                        self.params,
+                        self._put(np.zeros((bsz, span), np.int32)),
+                        self._put(np.zeros((bsz, span), np.int32)),
+                        self._put(np.ones((bsz,), np.int32)),
+                        self.kv.k,
+                        self.kv.v,
+                        self._put(
+                            np.zeros(
+                                (bsz, self.max_blocks_per_seq), np.int32
+                            )
+                        ),
+                        self._put(np.zeros((bsz,), np.int32)),
+                        self._put(np.zeros((bsz,), np.float32)),
+                        self._put(np.ones((bsz,), np.float32)),
+                        self._put(np.zeros((bsz,), np.float32)),
+                        jax.random.PRNGKey(0),
+                        self._put(np.zeros((cb, bucket), np.int32)),
+                        self._put(np.zeros((cb, bucket), np.int32)),
+                        self._put(
+                            np.zeros((cb, self.max_blocks_per_seq), np.int32)
+                        ),
+                        self._put(np.ones((cb,), np.int32)),
+                        self._put(np.zeros((cb,), np.int32)),
+                        self._put(np.zeros((cb,), np.float32)),
+                        self._put(np.ones((cb,), np.float32)),
+                        self._put(np.zeros((cb,), np.float32)),
+                    )
+                )
+                np.asarray(spec_tokens)
         # On this backend block_until_ready does not synchronize; a tiny
         # host fetch is the only reliable completion barrier.
         np.asarray(tokens)
@@ -908,6 +1116,18 @@ class LLMEngine:
             params=params or SamplingParams(),
             t_enqueue=time.monotonic(),
         )
+        if (
+            self.config.draft_k
+            and self.config.spec_draft_source == 'prompt_lookup'
+            and request.params.temperature <= 0
+        ):
+            # Prompt-lookup drafting is greedy-only: acceptance compares
+            # drafts against the row's own sampled tokens, deterministic
+            # only at temperature 0. Stochastic rows fall back to span 1
+            # (plain single-step verify — no drafting, no wrong trade).
+            from distllm_tpu.generate.engine.spec import PromptLookupDrafter
+
+            request.drafter = PromptLookupDrafter(self.config.spec_ngram)
         cached_blocks: list[int] = []
         if self.prefix_cache is not None:
             bs = self.config.block_size
@@ -1174,16 +1394,22 @@ class LLMEngine:
             budget -= ntok
         return plan
 
-    def _span_host_arrays(self, spans, bucket: int, rows: int):
+    def _span_host_arrays(self, spans, bucket: int, rows: int,
+                          token_rows=None):
         """The padded paged-span host arrays — (ids, positions,
         block_rows, context_lens, tail_lens) — for ``spans`` =
         ``[(request, start, ntok)]``. ONE builder shared by standalone
-        paged prefill and mixed chunk rows: the span/padding contract
-        (trash-routed pads, clamped RoPE positions) is exactly what the
-        mixed-vs-pure bit-identity guarantee rests on, so it must not be
-        able to diverge between the two dispatch paths. Pad rows carry
-        tail 0 + all-zero tables: writes land in the trash block and
-        their logits are garbage the caller discards."""
+        paged prefill, mixed chunk rows, and speculative verify spans:
+        the span/padding contract (trash-routed pads, clamped RoPE
+        positions) is exactly what the mixed-vs-pure and spec-on/off
+        bit-identity guarantees rest on, so it must not be able to
+        diverge between the dispatch paths. Pad rows — and spans whose
+        ``request`` is None or ``ntok`` 0 (inactive slots in a spec
+        window's slot-indexed layout) — carry tail 0 + all-zero tables:
+        writes land in the trash block and their logits are garbage the
+        caller discards. ``token_rows`` (parallel to ``spans``) supplies
+        each span's tokens explicitly instead of slicing the request's
+        history — verify spans carry drafts that are not history yet."""
         ids = np.zeros((rows, bucket), np.int32)
         positions = np.zeros((rows, bucket), np.int32)
         block_rows = np.zeros((rows, self.max_blocks_per_seq), np.int32)
@@ -1191,9 +1417,15 @@ class LLMEngine:
         tail_lens = np.zeros((rows,), np.int32)
         max_pos = self.config.max_model_len - 1
         for i, (request, start, ntok) in enumerate(spans):
-            toks = (request.prompt_ids + request.output_ids)[
-                start : start + ntok
-            ]
+            if request is None or ntok <= 0:
+                continue  # inactive slot: the pad-row contract applies
+            toks = (
+                token_rows[i][:ntok]
+                if token_rows is not None
+                else (request.prompt_ids + request.output_ids)[
+                    start : start + ntok
+                ]
+            )
             ids[i, :ntok] = toks
             # Padding columns clamp to max_model_len-1 so the RoPE table
             # gather stays in range; their writes are masked to trash.
@@ -1594,12 +1826,15 @@ class LLMEngine:
             kmax = max(kmax, unacked + self._window_budget(request, unacked, k))
         return kmax
 
-    def _reserve_shortfall(self, kmax: int) -> int:
+    def _reserve_shortfall(self, kmax: int, row_ks=None) -> int:
         """Blocks ``prepare_decode(kmax)`` would need beyond what running
         sequences already own — used by the pipelined loop to guarantee no
         preemption happens while windows are in flight (preempting a
         sequence whose blocks an in-flight window still writes to would
-        let a re-allocation corrupt another sequence's KV)."""
+        let a re-allocation corrupt another sequence's KV). ``row_ks``
+        (speculative windows) replaces the uniform ``kmax`` with each
+        row's own headroom; rows absent from it take no decode extension
+        this window."""
         bs = self.config.block_size
         short = 0
         for _, rid in self.sched.running():
@@ -1612,7 +1847,10 @@ class LLMEngine:
                 # pipelined drain-before-preempt guard and the scheduler
                 # agree on the shortfall.
                 continue
-            target = -(-(request.num_tokens + kmax) // bs)
+            k_row = kmax if row_ks is None else row_ks.get(rid)
+            if k_row is None:
+                continue  # not participating in this spec window
+            target = -(-(request.num_tokens + k_row) // bs)
             short += max(0, target - len(self.sched.block_row(rid)))
         return short
 
@@ -1628,7 +1866,14 @@ class LLMEngine:
         in-flight window record, or ``_DRAIN`` when every running slot's
         budget is already covered by in-flight windows AND no chunk work
         is pending (caller should process one).
+
+        ``draft_k > 0`` routes to the speculative verify window instead
+        (docs/speculative.md): one ragged dispatch scoring every row's
+        prompt-lookup draft span. Spec windows ignore ``carried_ids`` —
+        they process synchronously, so host state is always current.
         """
+        if self.config.draft_k:
+            return self._dispatch_spec_window()
         k = self.config.decode_steps
         kmax = self._window_kmax()
         decode_rids = None
@@ -1798,6 +2043,243 @@ class LLMEngine:
             'chunk_plan': chunk_entries,
         }
 
+    # ------------------------------------------- speculative verify windows
+    def _dispatch_spec_window(self) -> dict | object:
+        """Plan and dispatch one speculative verify window
+        (docs/speculative.md).
+
+        For every decode-ready row the prompt-lookup drafter proposes up
+        to ``draft_k`` tokens from the row's own history; the row's span
+        ``[last_emitted_token, drafts...]`` rides ONE ragged dispatch
+        (``mistral.spec_window`` — the same write-then-attend kernel as
+        paged prefill) that scores all positions in a single weight pass.
+        Block headroom is reserved PER ROW (``prepare_decode(..., ks)``):
+        each row gets exactly its own span, not the batch max. Composes
+        with mixed batching — pending prefill-chunk rows ride the same
+        dispatch through the chunk-carrying variant. Returns the window
+        record for ``_process_spec_window``, or ``_DRAIN`` when nothing
+        can ride.
+        """
+        cfg = self.config
+        draft_k = cfg.draft_k
+        drafts_by_rid: dict[int, list[int]] = {}
+        decode_rids: list[int] = []
+        row_ks: list[int] = []
+        for _, rid in self.sched.running():
+            request = self._requests[rid]
+            if not self._decode_ready(request):
+                continue
+            # The drafter may propose at most budget-1 tokens: a window
+            # emits accepted+1 tokens, and emission must never overshoot
+            # max_tokens / max_model_len (spec discards nothing emitted).
+            budget = self._window_budget(request, 0, draft_k + 1)
+            if budget <= 0:
+                continue
+            drafts: list[int] = []
+            if budget > 1 and request.drafter is not None:
+                drafts = request.drafter.draft(
+                    request.prompt_ids + request.output_ids,
+                    min(draft_k, budget - 1),
+                )
+            drafts_by_rid[rid] = drafts
+            decode_rids.append(rid)
+            # Per-row headroom: the span writes K/V up to position
+            # num_tokens - 1 + len(drafts), i.e. num_tokens + len(drafts)
+            # tokens of coverage; 1 keeps the classic single-step floor.
+            row_ks.append(max(1, len(drafts)))
+        if decode_rids:
+            self._evict_cached_blocks(
+                self._reserve_shortfall(
+                    1, row_ks=dict(zip(decode_rids, row_ks))
+                )
+                - self.sched.num_free_blocks
+            )
+            try:
+                preempted = self.sched.prepare_decode(
+                    1, decode_rids, row_ks
+                )
+            except SchedulerExhausted as exc:
+                for rid in exc.preempted:
+                    self._on_preempt(self._requests[rid])
+                raise
+            for rid in preempted:
+                # Spec windows process synchronously, so victims never
+                # have in-flight tokens; recompute preemption re-prefills
+                # them (preemption mid-draft: the un-dispatched draft is
+                # simply dropped with the rest of the row's state).
+                self._on_preempt(self._requests[rid])
+                drafts_by_rid.pop(rid, None)
+        chunk_plan = self._plan_window_chunks()
+
+        b = cfg.max_num_seqs
+        span = 1 + draft_k
+        spans: list = [(None, 0, 0)] * b
+        token_rows: list = [[]] * b
+        temperature = np.zeros((b,), np.float32)
+        top_p = np.ones((b,), np.float32)
+        min_p = np.zeros((b,), np.float32)
+        plan: list[tuple[int, int, list[int]]] = []
+        for slot, rid in self.sched.running():
+            drafts = drafts_by_rid.get(rid)
+            if drafts is None:
+                continue
+            request = self._requests[rid]
+            if request.state is not RequestState.RUNNING:
+                continue
+            last = (
+                request.output_ids[-1]
+                if request.output_ids
+                else request.prompt_ids[-1]
+            )
+            # The span starts at the last emitted token's position (its
+            # K/V is not yet written — decode's write-then-attend
+            # contract) and extends through the drafts.
+            spans[slot] = (request, request.num_tokens - 1, 1 + len(drafts))
+            token_rows[slot] = [last] + drafts
+            temperature[slot] = request.params.temperature
+            top_p[slot] = request.params.top_p
+            min_p[slot] = request.params.min_p
+            plan.append((slot, rid, drafts))
+        if not plan and not chunk_plan:
+            return _DRAIN
+
+        ids, positions, block_rows, context_lens, tail_lens = (
+            self._span_host_arrays(spans, span, b, token_rows=token_rows)
+        )
+        host_arrays = [
+            ids, positions, block_rows, context_lens, tail_lens,
+            temperature, top_p, min_p,
+        ]
+        if chunk_plan:
+            host_arrays.extend(self._build_chunk_arrays(chunk_plan))
+        devs = self._put_many(*host_arrays)
+        self._key, key = jax.random.split(self._key)
+        chunk_tokens = None
+        chunk_entries: list[tuple[int, int, int, int, bool]] = []
+        if chunk_plan:
+            tokens, self.kv.k, self.kv.v, chunk_tokens = (
+                self._spec_mixed_window(
+                    self.params,
+                    devs[0],  # span ids
+                    devs[1],  # span positions
+                    devs[3],  # context_lens
+                    self.kv.k,
+                    self.kv.v,
+                    devs[2],  # block tables
+                    devs[4],  # span_lens
+                    devs[5],
+                    devs[6],
+                    devs[7],
+                    key,
+                    *devs[8:],
+                )
+            )
+            ridden = 0
+            for i, (request, start, ntok) in enumerate(chunk_plan):
+                request.prefill_sent = start + ntok
+                final = start + ntok >= request.prefill_target
+                chunk_entries.append(
+                    (i, request.request_id, start, ntok, final)
+                )
+                ridden += ntok
+            # The ridden-prefill series stay truthful regardless of which
+            # window kind carried the chunks; the WINDOW itself counts as
+            # spec (one dispatch is one window).
+            self._stats['spec_chunk_windows'] += 1
+            self._stats['mixed_prefill_tokens'] += ridden
+            _metrics.MIXED_PREFILL_TOKENS.inc(ridden)
+            _metrics.MIXED_PREFILL_TOKENS_PER_WINDOW.observe(ridden)
+            _metrics.MIXED_PREFILL_ROWS.observe(len(chunk_plan))
+        else:
+            tokens, self.kv.k, self.kv.v, _ = self._spec_window(
+                self.params,
+                devs[0],
+                devs[1],
+                devs[3],
+                self.kv.k,
+                self.kv.v,
+                devs[2],
+                devs[4],
+                devs[5],
+                devs[6],
+                devs[7],
+                key,
+            )
+        ndrafted = sum(len(d) for _, _, d in plan)
+        self._stats['spec_windows'] += 1
+        self._stats['spec_draft_tokens'] += ndrafted
+        _metrics.SPEC_WINDOWS.inc()
+        if ndrafted:
+            _metrics.SPEC_DRAFT_TOKENS.inc(ndrafted)
+        return {
+            'spec': True,
+            'tokens': tokens,
+            'plan': plan,
+            'chunk_tokens': chunk_tokens,
+            'chunk_plan': chunk_entries,
+            't_dispatch': time.monotonic(),
+            'last_ids': None,
+        }
+
+    def _process_spec_window(self, window: dict) -> list[tuple[int, int]]:
+        """Fetch one verify window's tokens and run the greedy acceptance
+        rule (the only host sync of the speculative path).
+
+        Per row, token ``i`` of the span is what sequential decode would
+        emit after consuming the span's first ``i+1`` tokens. Token 0 is
+        always emitted (it follows the last REAL token); draft ``i`` is
+        accepted — and token ``i+1`` emitted — only while it equals the
+        previously emitted token, so the output stream is exactly the
+        sequential greedy stream (each accepted draft skipped one weight
+        pass). EOS / max_tokens inside the accepted prefix finish the
+        request mid-span and the remaining verified tokens are discarded.
+        Rejected suffixes roll back: ``sched.trim`` returns the unused
+        per-row headroom so scheduler state matches a never-drafted run
+        (the rejected K/V needs no rollback — it sits at positions every
+        later dispatch overwrites before attending or masks out).
+        """
+        tokens = np.asarray(window['tokens'])  # [B, S]
+        emitted: list[tuple[int, int]] = []
+        drafted = accepted = rows = 0
+        for slot, rid, drafts in window['plan']:
+            request = self._requests.get(rid)
+            if request is None or request.state is not RequestState.RUNNING:
+                continue  # finished/preempted during an abnormal drain
+            rows += 1
+            drafted += len(drafts)
+            token = int(tokens[slot, 0])
+            self._emit_token(request, token)
+            emitted.append((rid, token))
+            for i, draft in enumerate(drafts):
+                if rid not in self._requests:
+                    break  # finished (EOS / max_tokens): discard the rest
+                if draft != token:
+                    break  # first mismatch: the correction is already out
+                accepted += 1
+                token = int(tokens[slot, i + 1])
+                self._emit_token(request, token)
+                emitted.append((rid, token))
+            if rid in self._requests and request.state is RequestState.RUNNING:
+                self.sched.trim(rid)
+        self._stats['spec_accepted_tokens'] += accepted
+        if accepted:
+            _metrics.SPEC_ACCEPTED_TOKENS.inc(accepted)
+        if drafted:
+            _metrics.SPEC_ACCEPT_RATE.observe(accepted / drafted)
+        chunk_entries = window.get('chunk_plan') or []
+        extra = {'draft_tokens': drafted, 'accepted_tokens': accepted}
+        if chunk_entries:
+            extra['prefill_tokens'] = sum(
+                n for *_, n, _ in chunk_entries
+            )
+            extra['prefill_rows'] = len(chunk_entries)
+        self._record_step(
+            'spec', window['t_dispatch'], batch=rows, tokens=len(emitted),
+            **extra,
+        )
+        emitted.extend(self._process_chunk_entries(window))
+        return emitted
+
     def _on_preempt(self, request: Request) -> None:
         request.state = RequestState.WAITING
         if self.prefix_cache is not None:
@@ -1823,7 +2305,11 @@ class LLMEngine:
         path) and fold them into request state; post-EOS overshoot tokens
         are discarded (counted in ``_stats['overshoot_tokens']`` — the
         bounded waste the pipelined EOS-one-window-late design trades for
-        hidden dispatch latency)."""
+        hidden dispatch latency). Speculative windows carry a different
+        token layout and acceptance rule and route to
+        ``_process_spec_window``."""
+        if window.get('spec'):
+            return self._process_spec_window(window)
         tokens = np.asarray(window['tokens'])  # [K, B]
         emitted: list[tuple[int, int]] = []
         chunk_entries = window.get('chunk_plan') or []
@@ -1859,41 +2345,59 @@ class LLMEngine:
                     self._stats['overshoot_tokens'] += steps - i - 1
                     _metrics.ENGINE_OVERSHOOT_TOKENS.inc(steps - i - 1)
                     break  # finished mid-window
-        if chunk_entries:
-            # The fetch above is the completion barrier: once the window's
-            # tokens are on host, its chunk K/V writes are in the cache.
-            chunk_tokens = np.asarray(window['chunk_tokens'])
-            for row_i, rid, start, ntok, final in chunk_entries:
-                request = self._requests.get(rid)
-                if request is None or request.state is not RequestState.RUNNING:
-                    continue  # preempted during an abnormal drain
-                request.prefill_done = max(
-                    request.prefill_done, start + ntok
-                )
-                if final:
-                    # Freshly prefilled full prompt blocks enter the
-                    # prefix cache BEFORE emission — a max_tokens=1
-                    # request finishes inside _emit_token, after which
-                    # its row is gone (same ordering as the standalone
-                    # paths).
-                    self._insert_prompt_blocks(request)
-                    try:
-                        self._prefilling.remove(rid)
-                    except ValueError:
-                        pass
-                    token = int(chunk_tokens[row_i])
-                    self._emit_token(request, token)
-                    emitted.append((rid, token))
+        emitted.extend(self._process_chunk_entries(window))
+        return emitted
+
+    def _process_chunk_entries(self, window: dict) -> list[tuple[int, int]]:
+        """Fold a fetched window's ridden prefill-chunk spans into request
+        state (shared by the mixed decode and speculative processors).
+        The caller's token fetch is the completion barrier: once the
+        window's tokens are on host, its chunk K/V writes are in the
+        cache."""
+        chunk_entries = window.get('chunk_plan') or []
+        emitted: list[tuple[int, int]] = []
+        if not chunk_entries:
+            return emitted
+        chunk_tokens = np.asarray(window['chunk_tokens'])
+        for row_i, rid, start, ntok, final in chunk_entries:
+            request = self._requests.get(rid)
+            if request is None or request.state is not RequestState.RUNNING:
+                continue  # preempted during an abnormal drain
+            request.prefill_done = max(
+                request.prefill_done, start + ntok
+            )
+            if final:
+                # Freshly prefilled full prompt blocks enter the
+                # prefix cache BEFORE emission — a max_tokens=1
+                # request finishes inside _emit_token, after which
+                # its row is gone (same ordering as the standalone
+                # paths).
+                self._insert_prompt_blocks(request)
+                try:
+                    self._prefilling.remove(rid)
+                except ValueError:
+                    pass
+                token = int(chunk_tokens[row_i])
+                self._emit_token(request, token)
+                emitted.append((rid, token))
         return emitted
 
     def _run_to_completion(self) -> None:
         """Drive all requests to completion with ``pipeline_depth`` decode
         windows in flight, so the ~68 ms host↔device round trip is hidden
         behind the next window's compute. EOS and admission react one
-        window late — bounded overshoot, unchanged results."""
+        window late — bounded overshoot, unchanged results.
+
+        Speculative mode (``draft_k > 0``) forces depth 1: the prompt-
+        lookup drafter needs each window's host-fetched tokens before it
+        can propose the next span, so windows process synchronously and
+        the latency trade shifts from dispatch-hiding to weight-pass-
+        skipping (docs/speculative.md)."""
         from collections import deque
 
-        depth = max(1, self.config.pipeline_depth)
+        depth = (
+            1 if self.config.draft_k else max(1, self.config.pipeline_depth)
+        )
         inflight: deque[dict] = deque()
         self._carried = None
 
@@ -2078,6 +2582,18 @@ class LLMEngine:
         if lookups:
             self.telemetry['prefix_hit_rate'] = round(
                 self._stats.get('prefix_hit_tokens', 0) / lookups, 4
+            )
+        drafted = self._stats.get('spec_draft_tokens', 0)
+        if drafted:
+            # Accepted tokens / drafted tokens — the speculative win in
+            # one number: every accepted token skipped a weight pass.
+            self.telemetry['spec_accept_rate'] = round(
+                self._stats.get('spec_accepted_tokens', 0) / drafted, 4
+            )
+        spec_windows = self._stats.get('spec_windows', 0)
+        if spec_windows and loop_s > 0:
+            self.telemetry['spec_windows_per_s'] = round(
+                spec_windows / loop_s, 2
             )
         if n_out:
             self.telemetry['overshoot_frac'] = round(

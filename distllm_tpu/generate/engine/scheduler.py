@@ -16,13 +16,21 @@ Policy contract (both implementations, tested in lockstep):
   blocks for ``num_tokens + 1`` are available (all-or-nothing). Blocks a
   request already carries (a borrowed prefix-cache prefix) count toward
   that budget: only the shortfall is allocated.
-- ``prepare_decode(k, rids=None)`` guarantees every running sequence can
-  take ``k`` more tokens (k > 1 backs multi-step fused decode windows),
-  preempting the youngest (highest rid) on OOM — recompute preemption:
-  blocks freed, request to the FRONT of the waiting queue. ``rids``
-  restricts the guarantee to the listed rows (mixed serving windows:
-  rows whose prefill chunks ride the window get no speculative decode
-  headroom — their blocks were fully allocated at admission).
+- ``prepare_decode(k, rids=None, ks=None)`` guarantees every running
+  sequence can take ``k`` more tokens (k > 1 backs multi-step fused
+  decode windows), preempting the youngest (highest rid) on OOM —
+  recompute preemption: blocks freed, request to the FRONT of the
+  waiting queue. ``rids`` restricts the guarantee to the listed rows
+  (mixed serving windows: rows whose prefill chunks ride the window get
+  no speculative decode headroom — their blocks were fully allocated at
+  admission). ``ks`` (parallel to ``rids``) grants PER-ROW headroom
+  instead of the uniform ``k`` — speculative verify windows reserve each
+  row's own ``1 + draft`` span rather than the batch max
+  (docs/speculative.md).
+- ``trim(rid)`` returns owned tail blocks beyond what ``num_tokens + 1``
+  needs to the free list (newest first, so a later extension re-pops the
+  identical blocks) — how a speculative window's rejected-suffix
+  reservation is rolled back to the never-drafted state.
 - Block 0 is the reserved trash block and is never allocated.
 
 Borrowed prefixes (automatic prefix caching, docs/prefix_caching.md): a
@@ -66,10 +74,15 @@ class Scheduler(Protocol):
     def admit_next(self) -> int | None: ...
 
     def prepare_decode(
-        self, k: int = 1, rids: 'list[int] | None' = None
+        self,
+        k: int = 1,
+        rids: 'list[int] | None' = None,
+        ks: 'list[int] | None' = None,
     ) -> list[int]: ...
 
     def append_token(self, rid: int) -> None: ...
+
+    def trim(self, rid: int) -> int: ...
 
     def finish(self, rid: int) -> None: ...
 
@@ -186,16 +199,32 @@ class PyScheduler:
         return True
 
     def prepare_decode(
-        self, k: int = 1, rids: 'list[int] | None' = None
+        self,
+        k: int = 1,
+        rids: 'list[int] | None' = None,
+        ks: 'list[int] | None' = None,
     ) -> list[int]:
         """``rids`` (mixed serving windows) restricts the k-token capacity
         guarantee to the listed running requests: rows mid-prefill inside
         a mixed window already own blocks for their full prompt from
         admission, so extending them too would waste pool and provoke
         spurious preemptions. Victims are still chosen youngest-first over
-        ALL running rows. ``None`` = every running row (classic policy)."""
+        ALL running rows. ``None`` = every running row (classic policy).
+        ``ks`` (parallel to ``rids``) overrides ``k`` per row — the
+        speculative verify window's per-row ``1 + draft`` headroom."""
         if k < 1:
             raise ValueError('k must be >= 1')
+        if ks is not None:
+            if rids is None or len(ks) != len(rids):
+                raise ValueError('ks must parallel rids')
+            if any(kk < 1 for kk in ks):
+                raise ValueError('per-row k must be >= 1')
+            if len(set(rids)) != len(rids):
+                # A duplicate rid would make the per-row k ambiguous (and
+                # the Python/native twins would resolve it differently):
+                # reject instead of silently picking one.
+                raise ValueError('duplicate rids with per-row ks')
+        per_row = dict(zip(rids, ks)) if ks is not None else None
         selected = None if rids is None else set(rids)
         preempted: list[int] = []
         for rid in list(self._slots):
@@ -206,7 +235,8 @@ class PyScheduler:
             req = self._requests[rid]
             if req.slot < 0:
                 continue  # preempted earlier in this loop
-            while not self._extend(req, req.num_tokens + k):
+            k_row = per_row[rid] if per_row is not None else k
+            while not self._extend(req, req.num_tokens + k_row):
                 victim = self._preempt_youngest()
                 if victim is None:
                     raise SchedulerExhausted(
@@ -221,6 +251,25 @@ class PyScheduler:
 
     def append_token(self, rid: int) -> None:
         self._requests[rid].num_tokens += 1
+
+    def trim(self, rid: int) -> int:
+        """Free owned tail blocks beyond ``blocks_needed(num_tokens + 1)``.
+
+        The rejected-suffix rollback of speculative windows: headroom
+        reserved for drafts that did not survive verification returns to
+        the free list, newest block first, so the free list (a LIFO) is
+        restored to exactly its pre-reservation state and a later
+        extension re-pops the identical blocks. Borrowed prefix blocks
+        are never touched. Returns the number of blocks freed.
+        """
+        req = self._requests[rid]
+        keep = max(self._blocks_needed(req.num_tokens + 1), req.num_borrowed)
+        freed = len(req.blocks) - keep
+        if freed <= 0:
+            return 0
+        self._free.extend(reversed(req.blocks[keep:]))
+        del req.blocks[keep:]
+        return freed
 
     def finish(self, rid: int) -> None:
         req = self._requests.pop(rid)
@@ -332,6 +381,17 @@ class NativeScheduler:
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.sched_prepare_decode_rows_k.restype = ctypes.c_int32
+        lib.sched_prepare_decode_rows_k.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.sched_trim.restype = ctypes.c_int32
+        lib.sched_trim.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         for name in ('sched_append_token', 'sched_finish'):
             fn = getattr(lib, name)
             fn.restype = ctypes.c_int32
@@ -396,18 +456,33 @@ class NativeScheduler:
         return None if rid < 0 else rid
 
     def prepare_decode(
-        self, k: int = 1, rids: 'list[int] | None' = None
+        self,
+        k: int = 1,
+        rids: 'list[int] | None' = None,
+        ks: 'list[int] | None' = None,
     ) -> list[int]:
         if k < 1:
             raise ValueError('k must be >= 1')
+        if ks is not None:
+            if rids is None or len(ks) != len(rids):
+                raise ValueError('ks must parallel rids')
+            if any(kk < 1 for kk in ks):
+                raise ValueError('per-row k must be >= 1')
+            if len(set(rids)) != len(rids):
+                raise ValueError('duplicate rids with per-row ks')
         out = (ctypes.c_int64 * self._max_num_seqs)()
         if rids is None:
             n = int(self._lib.sched_prepare_decode_k(self._handle, k, out))
         else:
             arr = (ctypes.c_int64 * max(1, len(rids)))(*rids)
+            ks_arr = (
+                (ctypes.c_int32 * max(1, len(ks)))(*ks)
+                if ks is not None
+                else None
+            )
             n = int(
-                self._lib.sched_prepare_decode_rows(
-                    self._handle, k, arr, len(rids), out
+                self._lib.sched_prepare_decode_rows_k(
+                    self._handle, k, arr, ks_arr, len(rids), out
                 )
             )
         if n < 0:
@@ -423,6 +498,12 @@ class NativeScheduler:
     def append_token(self, rid: int) -> None:
         if self._lib.sched_append_token(self._handle, rid) != 0:
             raise KeyError(rid)
+
+    def trim(self, rid: int) -> int:
+        n = int(self._lib.sched_trim(self._handle, rid))
+        if n < 0:
+            raise KeyError(rid)
+        return n
 
     def finish(self, rid: int) -> None:
         if self._lib.sched_finish(self._handle, rid) != 0:
@@ -541,10 +622,13 @@ class InstrumentedScheduler:
         return rid
 
     def prepare_decode(
-        self, k: int = 1, rids: 'list[int] | None' = None
+        self,
+        k: int = 1,
+        rids: 'list[int] | None' = None,
+        ks: 'list[int] | None' = None,
     ) -> list[int]:
         try:
-            preempted = self._inner.prepare_decode(k, rids)
+            preempted = self._inner.prepare_decode(k, rids, ks)
         except SchedulerExhausted as exc:
             # Preemptions performed before the fatal exhaustion still
             # happened; count them before propagating.
@@ -577,6 +661,12 @@ class InstrumentedScheduler:
         # No _sync: appending only bumps the token count — block
         # allocation happens in prepare_decode, which does sync.
         self._inner.append_token(rid)
+
+    def trim(self, rid: int) -> int:
+        freed = self._inner.trim(rid)
+        if freed:
+            self._sync()
+        return freed
 
     def finish(self, rid: int) -> None:
         self._inner.finish(rid)

@@ -1,0 +1,85 @@
+"""Prompt-lookup speculative drafting (docs/speculative.md).
+
+The dependency-free drafter behind ``EngineConfig.draft_k``: instead of a
+second model, each request's OWN prompt+output history is the draft
+source. An n-gram ending at some earlier position predicts that the
+tokens which followed it will follow again — the classic prompt-lookup
+decoding heuristic, and a strong one for the serving shapes this engine
+targets (RAG contexts quoted back in answers, MCQA stems, code, chat
+turns that restate the question).
+
+The lookup table reuses the prefix cache's token hasher
+(:func:`~distllm_tpu.generate.engine.kv_cache.hash_block_tokens`): one
+sha256 digest per ``ngram``-token window, mapped to the position just
+past that window's most recent occurrence. The same collision-safety
+argument applies — a digest collision would splice another suffix's
+continuation into the draft, which the verify pass would merely reject
+(correctness is never at stake; only the acceptance rate), but the
+hasher is already battle-tested and fast enough for the host loop.
+
+Drafts are PROPOSALS only: the engine verifies all of them in one ragged
+dispatch and keeps the longest matching prefix, so a bad draft costs one
+span slot, never a wrong token (``LLMEngine._process_spec_window``).
+
+Cost note: the first ``draft`` call after admission indexes the whole
+prompt — one sha256 of a tiny n-gram string per position, sub-µs each,
+~30 ms one-time at 32k context — and stays incremental afterwards (vLLM's
+prompt-lookup re-scans the whole prompt EVERY step). If prompt-index
+time ever shows in profiles, plain ``tuple`` keys are the drop-in
+micro-optimization; the digest form is kept for parity with the prefix
+cache's hash-chain machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from distllm_tpu.generate.engine.kv_cache import hash_block_tokens
+
+
+class PromptLookupDrafter:
+    """Per-request n-gram → continuation index over the token history.
+
+    Incremental: ``draft`` indexes only history positions it has not seen
+    yet (the table survives across windows and recompute preemption —
+    preemption keeps prompt and outputs, so every indexed position stays
+    valid). The terminal n-gram (the one ending at the last token) is
+    never indexed while it is terminal: it is the lookup KEY, and mapping
+    it to itself would always propose the empty continuation.
+    """
+
+    def __init__(self, ngram: int = 2) -> None:
+        if ngram < 1:
+            raise ValueError('ngram must be >= 1')
+        self.ngram = ngram
+        # digest of the ngram ending at position p -> p + 1 (continuation
+        # start); later occurrences overwrite earlier ones, so lookups
+        # resolve to the MOST RECENT match (recency beats frequency for
+        # the repetitive serving shapes prompt lookup exploits).
+        self._table: dict[bytes, int] = {}
+        # History positions whose ending-ngram has been indexed: every
+        # p < _indexed_end is in the table.
+        self._indexed_end = 0
+
+    def _digest(self, tokens: Sequence[int]) -> bytes:
+        return hash_block_tokens(None, tokens)
+
+    def draft(self, history: Sequence[int], k: int) -> list[int]:
+        """Up to ``k`` proposed continuation tokens for ``history``.
+
+        Empty when ``k <= 0``, the history is shorter than the n-gram, or
+        the final n-gram has no earlier occurrence.
+        """
+        n = self.ngram
+        end = len(history)
+        # Index every ngram ending strictly before the terminal position.
+        start = max(self._indexed_end, n - 1)
+        for p in range(start, end - 1):
+            self._table[self._digest(history[p - n + 1 : p + 1])] = p + 1
+        self._indexed_end = max(self._indexed_end, end - 1)
+        if k <= 0 or end < n:
+            return []
+        pos = self._table.get(self._digest(history[end - n : end]))
+        if pos is None:
+            return []
+        return [int(t) for t in history[pos : pos + k]]

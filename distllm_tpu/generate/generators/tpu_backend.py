@@ -95,6 +95,38 @@ class TpuGeneratorConfig(BaseConfig):
         description='Prefill-chunk token budget one mixed window may '
         'carry (each token bucket is one extra compiled window shape).',
     )
+    draft_k: int | None = Field(
+        default=None,
+        ge=0,
+        description='Prompt-lookup speculative decoding: draft up to '
+        'this many tokens per row from the row\'s own history and '
+        'verify them in one ragged dispatch — every accepted token '
+        'skipped a weight pass (docs/speculative.md). Greedy-only '
+        '(temperature must be 0); 0 disables.',
+    )
+    spec_ngram: int | None = Field(
+        default=None,
+        ge=1,
+        description='n-gram length the prompt-lookup drafter matches on.',
+    )
+
+    @model_validator(mode='after')
+    def _spec_requires_greedy(self) -> 'TpuGeneratorConfig':
+        if self.draft_k and self.temperature > 0:
+            # The acceptance rule compares drafts against the row's OWN
+            # sampled token, which is deterministic only under greedy
+            # decoding; with temperature > 0 the engine would fall back
+            # to draft_k=0 per row anyway, so a config asking for both is
+            # asking for speculation it can never get — fail loudly
+            # instead of serving a silently inert knob
+            # (docs/speculative.md).
+            raise ValueError(
+                'draft_k > 0 requires temperature == 0: speculative '
+                'verification is greedy-only (the engine would disable '
+                'drafting per-row for stochastic sampling, making the '
+                'knob inert) — see docs/speculative.md'
+            )
+        return self
 
     @model_validator(mode='after')
     def _xor_top_p_min_p(self) -> 'TpuGeneratorConfig':
@@ -240,6 +272,8 @@ class TpuGenerator:
                             'max_window_prefill_tokens',
                             config.max_window_prefill_tokens,
                         ),
+                        ('draft_k', config.draft_k),
+                        ('spec_ngram', config.spec_ngram),
                     )
                     if value is not None
                 },

@@ -337,6 +337,7 @@ def prefill_paged(
     context_lens: jnp.ndarray,  # [B] total valid tokens incl. this tail
     tail_lens: jnp.ndarray,  # [B] valid tokens in input_ids (0 = pad row)
     max_table_positions: int | None = None,
+    all_logits: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefill an UNCACHED TAIL against KV history already in the paged
     cache — the prefix-cache hit / chunked-prefill forward
@@ -352,6 +353,12 @@ def prefill_paged(
     ``last_logits`` is sampled at each row's last valid tail position.
     Positions at or past ``tail_lens`` (padding) write to trash block 0
     and their logits are garbage the caller discards.
+
+    ``all_logits=True`` (speculative verification, :func:`spec_window`)
+    returns logits at EVERY span position — ``[B, S, V]`` — instead of
+    only the last one; the forward pass itself is unchanged, so the
+    verify dispatch shares every numeric property of this path (the
+    greedy-identity backbone of docs/speculative.md).
     """
     from distllm_tpu.ops.paged_attention import (
         ragged_paged_attention_xla,
@@ -442,6 +449,10 @@ def prefill_paged(
         ),
     )
     hidden = _norm(x, params['final_ln']['scale'], cfg)
+    if all_logits:
+        # Speculative verification needs every span position's logits;
+        # spans are short (1 + draft_k), so [B, S, V] stays small.
+        return logits(params, cfg, hidden), k_cache, v_cache
     # Only each row's last valid tail position feeds the lm_head ([B, S, V]
     # logits would waste MXU time and HBM — same policy as prefill).
     last_idx = jnp.maximum(tail_lens - 1, 0)
@@ -887,6 +898,84 @@ def mixed_window(
         sampling_top_window=sampling_top_window, layer_unroll=layer_unroll,
     )
     return tokens, k_cache, v_cache, last_ids, chunk_tokens
+
+
+def spec_window(
+    params: dict,
+    cfg: MistralConfig,
+    # --- ragged verify-span operands (prefill_paged shapes) ---
+    span_ids: jnp.ndarray,  # [B, S] last emitted token + draft tokens
+    span_positions: jnp.ndarray,  # [B, S] absolute positions
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    context_lens: jnp.ndarray,  # [B] total valid tokens incl. the span
+    span_lens: jnp.ndarray,  # [B] valid span tokens (0 = inactive slot)
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    min_p: jnp.ndarray,  # [B]
+    key: jax.Array,
+    # --- optional prefill-chunk operands (mixed batching composition) ---
+    chunk: tuple | None = None,  # (ids, pos, bt, ctx, tails, temp, tp, mp)
+    max_table_positions: int | None = None,
+    sampling_top_window: int = 0,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray | None]:
+    """One SPECULATIVE verify window: score every row's draft span in a
+    single ragged dispatch (docs/speculative.md).
+
+    Each row carries ``[last_emitted_token, d_1, .., d_k]`` at absolute
+    positions ``num_tokens-1 ..`` — the exact per-row-query-span shape
+    :func:`prefill_paged` already dispatches (write-then-attend through
+    ``ragged_paged_attention_xla``), so one weight pass scores all
+    ``1+draft_k`` positions. Position ``i``'s sampled token is what
+    sequential decode would emit after consuming the span's first ``i+1``
+    tokens; the engine's host-side acceptance rule keeps the longest
+    prefix where draft ``d_{i+1}`` equals token ``i``. Rejected suffixes
+    need no device-side rollback: their K/V writes sit at positions at or
+    beyond the row's post-acceptance ``num_tokens``, which every later
+    dispatch either overwrites before attending (write-then-attend) or
+    masks out (``kv_pos <= q_pos``).
+
+    ``chunk`` (pytree-static; ``None`` compiles a chunk-free graph)
+    carries mixed-batching prefill-chunk rows exactly as
+    :func:`mixed_window` does — same :func:`prefill_paged` pass, so the
+    chunk half stays bit-identical to its standalone dispatch.
+
+    Returns ``(span_tokens [B, S] int32, k_cache, v_cache, chunk_tokens
+    [C] | None)``. Greedy rows (temperature 0) ignore the key, which is
+    what the speculation-on/off token-identity guarantee rests on;
+    stochastic rows ride with span length 1 (the engine never drafts for
+    them) and draw from a different key-split order than the decode scan.
+    """
+    from distllm_tpu.ops.sampling import sample_tokens
+
+    chunk_tokens = None
+    if chunk is not None:
+        c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp, c_top_p, c_min_p = chunk
+        chunk_key, key = jax.random.split(key)
+        chunk_logits, k_cache, v_cache = prefill_paged(
+            params, cfg, c_ids, c_pos, k_cache, v_cache, c_bt, c_ctx,
+            c_tails, max_table_positions=max_table_positions,
+        )
+        chunk_tokens = sample_tokens(
+            chunk_logits, chunk_key, c_temp, c_top_p, c_min_p,
+            top_window=sampling_top_window,
+        )
+    span_logits, k_cache, v_cache = prefill_paged(
+        params, cfg, span_ids, span_positions, k_cache, v_cache,
+        block_tables, context_lens, span_lens,
+        max_table_positions=max_table_positions, all_logits=True,
+    )
+    b, s, vocab = span_logits.shape
+    flat_tokens = sample_tokens(
+        span_logits.reshape(b * s, vocab),
+        key,
+        jnp.repeat(temperature, s),
+        jnp.repeat(top_p, s),
+        jnp.repeat(min_p, s),
+        top_window=sampling_top_window,
+    )
+    return flat_tokens.reshape(b, s), k_cache, v_cache, chunk_tokens
 
 
 def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray:

@@ -17,7 +17,12 @@
 //     where K tokens are generated per dispatch); on OOM, preempt the
 //     youngest (highest request id) running request — free its blocks,
 //     push it to the FRONT of the waiting queue (recompute preemption: it
-//     will re-prefill prompt + generated).
+//     will re-prefill prompt + generated). The rows_k variant grants
+//     PER-ROW headroom (speculative verify windows reserve each row's
+//     own 1 + draft span rather than the batch max).
+//   - trim(rid): return owned tail blocks beyond blocks_needed(num_tokens
+//     + 1) to the free list, newest first (LIFO restore) — the rejected-
+//     suffix rollback of speculative windows.
 //   - block 0 is the reserved trash block and is never handed out.
 //   - borrowed prefixes (automatic prefix caching): the first
 //     `num_borrowed` blocks of a request's row are prefix-cache property —
@@ -211,23 +216,41 @@ int64_t sched_admit_next(void* h) {
 // youngest-first over ALL running rows (a mid-prefill row may be
 // recompute-preempted; the engine resets its chunk progress).
 // rids == nullptr means "all running rows" (the classic policy).
-int32_t sched_prepare_decode_rows(void* h, int32_t k, const int64_t* rids,
-                                  int32_t n_rids, int64_t* out_preempted) {
+// ks (nullable, parallel to rids) overrides k per row: speculative verify
+// windows reserve each row's own 1 + draft span instead of the batch max.
+int32_t sched_prepare_decode_rows_k(void* h, int32_t k, const int64_t* rids,
+                                    const int32_t* ks, int32_t n_rids,
+                                    int64_t* out_preempted) {
     auto* s = static_cast<Scheduler*>(h);
     // INT32_MIN = argument error; must not collide with the fatal-
     // exhaustion encoding -(1 + n_preempted).
     if (k < 1 || n_rids < 0) return INT32_MIN;
+    if (ks != nullptr) {
+        if (rids == nullptr) return INT32_MIN;
+        for (int32_t i = 0; i < n_rids; ++i) {
+            if (ks[i] < 1) return INT32_MIN;
+            // Duplicate rids would make the per-row k ambiguous (and
+            // first-wins here vs last-wins in the Python twin's dict
+            // would silently break lockstep parity): argument error.
+            for (int32_t j = 0; j < i; ++j)
+                if (rids[j] == rids[i]) return INT32_MIN;
+        }
+    }
     int32_t n_preempted = 0;
     std::vector<int64_t> snapshot(s->slots);
     for (int64_t rid : snapshot) {
         if (rid < 0) continue;
-        if (rids != nullptr &&
-            std::find(rids, rids + n_rids, rid) == rids + n_rids)
-            continue;  // not selected for decode this window
+        int32_t k_row = k;
+        if (rids != nullptr) {
+            const int64_t* hit = std::find(rids, rids + n_rids, rid);
+            if (hit == rids + n_rids)
+                continue;  // not selected for decode this window
+            if (ks != nullptr) k_row = ks[hit - rids];
+        }
         Request& req = s->requests[rid];
         if (req.slot < 0) continue;  // preempted earlier in this loop
         bool preempted_self = false;
-        while (!s->extend(req, req.num_tokens + k)) {
+        while (!s->extend(req, req.num_tokens + k_row)) {
             int64_t victim = s->preempt_youngest();
             if (victim < 0) return -(1 + n_preempted);
             out_preempted[n_preempted++] = victim;
@@ -241,8 +264,35 @@ int32_t sched_prepare_decode_rows(void* h, int32_t k, const int64_t* rids,
     return n_preempted;
 }
 
+int32_t sched_prepare_decode_rows(void* h, int32_t k, const int64_t* rids,
+                                  int32_t n_rids, int64_t* out_preempted) {
+    return sched_prepare_decode_rows_k(h, k, rids, nullptr, n_rids,
+                                       out_preempted);
+}
+
 int32_t sched_prepare_decode_k(void* h, int32_t k, int64_t* out_preempted) {
-    return sched_prepare_decode_rows(h, k, nullptr, 0, out_preempted);
+    return sched_prepare_decode_rows_k(h, k, nullptr, nullptr, 0,
+                                       out_preempted);
+}
+
+// Free owned tail blocks beyond blocks_needed(num_tokens + 1), newest
+// first so the LIFO free list is restored to its pre-reservation state (a
+// later extension re-pops the identical blocks). Borrowed prefix blocks
+// are never touched. Returns the count freed, or -1 for an unknown rid.
+int32_t sched_trim(void* h, int64_t rid) {
+    auto* s = static_cast<Scheduler*>(h);
+    auto it = s->requests.find(rid);
+    if (it == s->requests.end()) return -1;
+    Request& req = it->second;
+    int32_t keep = std::max(s->blocks_needed(req.num_tokens + 1),
+                            req.num_borrowed);
+    int32_t freed = static_cast<int32_t>(req.blocks.size()) - keep;
+    if (freed <= 0) return 0;
+    for (int32_t i = static_cast<int32_t>(req.blocks.size()) - 1; i >= keep;
+         --i)
+        s->free_list.push_back(req.blocks[i]);
+    req.blocks.resize(keep);
+    return freed;
 }
 
 int32_t sched_prepare_decode(void* h, int64_t* out_preempted) {

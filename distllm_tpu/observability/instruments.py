@@ -138,6 +138,29 @@ MIXED_PREFILL_ROWS = _registry.histogram(
     buckets=(1, 2, 4, 8),
 )
 
+# ------------------------------------- speculative (prompt-lookup) decoding
+SPEC_WINDOWS = _registry.counter(
+    'distllm_engine_spec_windows_total',
+    'Speculative verify-window dispatches (EngineConfig.draft_k; '
+    'docs/speculative.md).',
+)
+SPEC_DRAFT_TOKENS = _registry.counter(
+    'distllm_engine_spec_draft_tokens_total',
+    'Draft tokens proposed by the prompt-lookup drafter and scored by '
+    'verify windows.',
+)
+SPEC_ACCEPTED_TOKENS = _registry.counter(
+    'distllm_engine_spec_accepted_tokens_total',
+    'Draft tokens accepted by the greedy verification rule — each one a '
+    'decode token that skipped its weight pass.',
+)
+SPEC_ACCEPT_RATE = _registry.histogram(
+    'distllm_engine_spec_accept_rate',
+    'Per-window draft acceptance rate (accepted / drafted; windows that '
+    'drafted nothing are not observed).',
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+)
+
 # ------------------------------------------------- request lifecycle (SLO)
 REQUEST_TTFT = _registry.histogram(
     'distllm_request_ttft_seconds',
@@ -168,7 +191,7 @@ GOODPUT_TOKENS = _registry.counter(
 ENGINE_STEPS = _registry.counter(
     'distllm_engine_steps_total',
     'Engine steps recorded by the flight recorder, by kind '
-    '(prefill/decode/mixed).',
+    '(prefill/decode/mixed/spec).',
     labelnames=('kind',),
 )
 ENGINE_STEP_SECONDS = _registry.histogram(
@@ -181,7 +204,7 @@ ENGINE_STEP_SECONDS = _registry.histogram(
 
 # Pre-create the fixed label sets so the full request-lifecycle schema is
 # present in the very first scrape, before any traffic.
-for _kind in ('prefill', 'decode', 'mixed'):
+for _kind in ('prefill', 'decode', 'mixed', 'spec'):
     ENGINE_STEPS.labels(kind=_kind)
     ENGINE_STEP_SECONDS.labels(kind=_kind)
 
@@ -195,6 +218,8 @@ FLIGHT_KINDS = frozenset({
     'prefill',  # one padded prefill dispatch (batched or paged-context)
     'decode',   # one fused decode window, dispatch -> host fetch
     'mixed',    # decode window that also carried prefill-chunk rows
+    'spec',     # speculative verify window (draft/accepted token fields;
+                # carries prefill_tokens/prefill_rows when chunk rows rode)
     'request',  # per-request lifecycle summary at finish
     'preempt',  # recompute preemption performed by prepare_decode
     'event',    # rare irregular events (scheduler exhaustion, ...)

@@ -16,27 +16,46 @@ jax = pytest.importorskip('jax')
 import jax.numpy as jnp  # noqa: E402
 
 
-def _compile_tolerating_mosaic_artifact(build):
-    """Run a compile, xfail-ing on the known Mosaic 'implicit dim change'
-    rejection.
+def _compile_tolerating_mosaic_artifact(build, mosaic_kernel: bool = True):
+    """Run a compile, xfail-ing ONLY on the known Mosaic 'implicit dim
+    change' rejection of the Pallas decode kernel.
 
-    This container's Mosaic toolchain rejects the Pallas paged-attention
-    decode kernel's block pattern with ``Not implemented: Overriding
-    implicit dim change``; the same kernel compiles AND is benchmarked on
-    the real chip environment (CHANGES.md PR 2 — left untouched there,
-    gated here per ISSUE 3). Gating on the *message* rather than a
-    toolchain version pin means a toolchain that fixes the bug turns
-    these back into hard tests automatically, and any OTHER compile
-    failure still fails loudly.
+    Some Mosaic toolchains reject the Pallas paged-attention decode
+    kernel's block pattern with an "implicit dim change" lowering error;
+    the same kernel compiles AND is benchmarked on the real chip
+    environment (CHANGES.md PR 2 — left untouched there, gated here per
+    ISSUE 3). Re-checked for ISSUE 8: the artifact is still present and
+    its message has MUTATED across toolchains — ``Not implemented:
+    Overriding implicit dim change`` (the ISSUE-3-era container) is now
+    ``Not implemented: Unsupported implicit dim change: from
+    "16,{0,0},(16,128),-2" to none`` (this container, measured
+    2026-08-04) — so the gate matches the stable ``implicit dim change``
+    family marker. Gating on the *message* rather than a toolchain
+    version pin means a toolchain that fixes the bug turns these back
+    into hard tests automatically. The gate is deliberately narrow so
+    nothing else is swallowed (tightened for ISSUE 8):
+
+    - ``mosaic_kernel=False`` (pure-XLA builds, where the artifact
+      cannot occur) never xfails — any failure raises;
+    - the error must self-identify as the Mosaic TPU compiler's
+      (``Mosaic failed to compile TPU kernel``) AND carry the
+      ``implicit dim change`` marker — any other Mosaic rejection, or a
+      non-Mosaic error whose text merely mentions the phrase, still
+      fails loudly.
     """
     try:
         return build()
     except Exception as exc:
-        if 'implicit dim change' in f'{exc!r}':
+        msg = f'{exc!r}'
+        if (
+            mosaic_kernel
+            and 'implicit dim change' in msg
+            and 'Mosaic failed to compile TPU kernel' in msg
+        ):
             pytest.xfail(
-                'known Mosaic toolchain artifact (implicit dim change) in '
-                'this container; kernel verified on the real chip '
-                f'environment: {exc!r}'[:300]
+                'known Mosaic toolchain artifact (implicit dim change); '
+                'kernel verified on the real chip '
+                f'environment: {msg}'[:300]
             )
         raise
 
@@ -106,7 +125,8 @@ def test_decode_window_compiles_for_tpu(v5e, backend):
     temps = {}
     for layer_unroll in (False, True):
         compiled = _compile_tolerating_mosaic_artifact(
-            lambda un=layer_unroll: jax.jit(
+            mosaic_kernel=(backend == 'pallas'),
+            build=lambda un=layer_unroll: jax.jit(
                 lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky,
                        un=un:
                     mistral.decode_loop(

@@ -63,13 +63,18 @@ def drive(sched, seed: int, steps: int = 200):
                 # mixed-serving-window path (rows mid-prefill get no
                 # decode headroom); None = classic all-rows policy.
                 rids = None
+                ks = None
                 if rng.integers(0, 2):
                     rids = [
                         rid for rid in sorted(live)
                         if sched.slot(rid) >= 0 and rng.integers(0, 2)
                     ]
+                    if rng.integers(0, 2):
+                        # Per-row headroom (speculative verify windows):
+                        # each selected row gets its own k.
+                        ks = [int(rng.integers(1, 6)) for _ in rids]
                 try:
-                    preempted = sched.prepare_decode(k, rids)
+                    preempted = sched.prepare_decode(k, rids, ks)
                 except SchedulerExhausted as exc:
                     # Fatal path reports prior same-call preemptions too;
                     # both implementations must agree on them.
@@ -78,6 +83,7 @@ def drive(sched, seed: int, steps: int = 200):
                     (
                         'prepare', k,
                         tuple(rids) if rids is not None else None,
+                        tuple(ks) if ks is not None else None,
                         tuple(preempted),
                     )
                 )
@@ -85,6 +91,14 @@ def drive(sched, seed: int, steps: int = 200):
                     if sched.slot(rid) >= 0:
                         sched.append_token(rid)
                         trace.append(('token', rid))
+                # Rejected-suffix rollback: trim a random running row's
+                # over-reservation back to num_tokens + 1 coverage.
+                running_now = [r for r in sorted(live) if sched.slot(r) >= 0]
+                if running_now and rng.integers(0, 2):
+                    victim = running_now[
+                        int(rng.integers(0, len(running_now)))
+                    ]
+                    trace.append(('trim', victim, sched.trim(victim)))
         else:
             running = [rid for rid in live if sched.slot(rid) >= 0]
             if running:
@@ -281,6 +295,76 @@ class TestPrepareDecodeK:
         # but the contract must hold).
         assert sched.prepare_decode(8, []) == []
         assert sched.num_free_blocks == free_before - 1
+
+    def test_per_row_ks_extends_each_row_its_own_headroom(
+        self, sched_factory
+    ):
+        """Speculative verify windows: prepare_decode(k, rids, ks) grants
+        each listed row ITS OWN reservation instead of the batch max."""
+        sched = sched_factory(num_blocks=32, block_size=4, max_num_seqs=3)
+        sched.add(0, 4)
+        sched.add(1, 4)
+        assert sched.admit_next() == 0
+        assert sched.admit_next() == 1
+        assert sched.prepare_decode(1, [0, 1], [9, 1]) == []
+        assert len(sched.block_row(0)) == 4  # ceil((4+9)/4)
+        assert len(sched.block_row(1)) == 2  # ceil((4+1)/4) — untouched
+
+    def test_per_row_ks_validation(self, sched_factory):
+        sched = sched_factory(num_blocks=16, block_size=4, max_num_seqs=2)
+        sched.add(0, 4)
+        assert sched.admit_next() == 0
+        with pytest.raises(ValueError):
+            sched.prepare_decode(1, [0], [2, 3])  # length mismatch
+        with pytest.raises(ValueError):
+            sched.prepare_decode(1, [0], [0])  # per-row k < 1
+        with pytest.raises(ValueError):
+            sched.prepare_decode(1, None, [2])  # ks without rids
+        with pytest.raises(ValueError):
+            # duplicate rids make the per-row k ambiguous (and would
+            # resolve differently in the two backends)
+            sched.prepare_decode(1, [0, 0], [2, 3])
+
+    def test_trim_returns_overreservation_restoring_free_order(
+        self, sched_factory
+    ):
+        """trim frees owned tail blocks beyond num_tokens + 1, newest
+        first, so the LIFO free list is restored exactly — a later
+        extension re-pops the identical blocks (the never-drafted-state
+        equality the speculative rollback relies on)."""
+        sched = sched_factory(num_blocks=16, block_size=4, max_num_seqs=2)
+        sched.add(0, 4)
+        assert sched.admit_next() == 0
+        free_before = sched.num_free_blocks
+        row_before = sched.block_row(0)
+        assert sched.prepare_decode(9, [0]) == []  # reserve to 4 blocks
+        assert len(sched.block_row(0)) == 4
+        assert sched.trim(0) == 2  # back to ceil(5/4) = 2 blocks
+        assert sched.block_row(0) == row_before
+        assert sched.num_free_blocks == free_before
+        # Re-extending hands back the same blocks in the same order.
+        grown = sched.block_row(0)
+        sched.prepare_decode(9, [0])
+        assert sched.block_row(0)[: len(grown)] == grown
+        assert sched.trim(0) == 2
+        assert sched.num_free_blocks == free_before
+
+    def test_trim_noop_and_unknown_rid(self, sched_factory):
+        sched = sched_factory(num_blocks=16, block_size=4, max_num_seqs=2)
+        sched.add(0, 4)
+        assert sched.admit_next() == 0
+        assert sched.trim(0) == 0  # admission reserve is exactly right
+        with pytest.raises(KeyError):
+            sched.trim(99)
+
+    def test_trim_never_frees_borrowed_prefix(self, sched_factory):
+        """Borrowed (prefix-cache) blocks are cache property even when
+        num_tokens shrinks below their coverage after preemption."""
+        sched = sched_factory(num_blocks=16, block_size=4, max_num_seqs=2)
+        sched.add(0, 3, cached_blocks=[5, 6, 7])  # 12 cached tokens > 3+1
+        assert sched.admit_next() == 0
+        assert sched.trim(0) == 0
+        assert sched.block_row(0) == [5, 6, 7]
 
     def test_rows_filter_can_preempt_unselected_victim(self, sched_factory):
         """Victims are still chosen youngest-first over ALL running rows:
