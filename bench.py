@@ -32,9 +32,10 @@ record and a global wall-clock deadline:
   composed from whatever the run record holds — so an external kill still
   publishes every completed stage;
 - stages run cheapest-first (embed → embed_q → gen → gen_prefix →
-  gen_mixed → gen_spec → gen_q: embed warmups are minutes, ``gen_prefix``/
-  ``gen_mixed``/``gen_spec`` reuse ``gen``'s compile cache, and int8
-  ``gen_q``'s cold warmup — 22–45 min in round 4 — goes last);
+  gen_mixed → gen_spec → gen_load → gen_q: embed warmups are minutes,
+  ``gen_prefix``/``gen_mixed``/``gen_spec``/``gen_load`` reuse ``gen``'s
+  compile cache, and int8 ``gen_q``'s cold warmup — 22–45 min in round 4 —
+  goes last);
 - a failing or SIGTERM'd stage dumps a debug bundle (flight ring, metrics,
   traces — ``observability.dump_debug_bundle``) so a dead stage still
   explains itself, and gen stages run under a ``StallWatchdog``.
@@ -974,6 +975,137 @@ def _stage_gen_spec() -> dict:
     return out
 
 
+def _stage_gen_load() -> dict:
+    """Open-loop load-generation stage (docs/observability.md): a
+    deterministic seeded Poisson arrival stream with a warm/cold prefix
+    mix, driven through ``distllm_tpu.generate.loadgen`` against a
+    prefix-cached engine with serving-path attribution ON.
+
+    The contract this stage checks and records:
+
+    - TTFT / TPOT / queue-wait p50/p95/p99 (``Histogram.quantile``
+      estimates over the request-lifecycle histogram deltas), goodput
+      under the configured TTFT SLO, and per-window throughput
+      percentiles;
+    - per-window-kind MFU and weight-stream bandwidth utilization from
+      the engine's roofline accumulators (``roofline_summary``);
+    - at least one warm-prefix cache hit (the warm sessions share
+      block-aligned prefixes — zero hits means the mix is broken);
+    - the SAME workload replayed with attribution flipped OFF emits
+      BIT-IDENTICAL tokens (attribution is pure host-side bookkeeping;
+      a mismatch is an error in the fragment).
+
+    ``DISTLLM_BENCH_LOAD=0`` skips the stage (chip runs that want the
+    deadline for the heavier stages).
+    """
+    import jax
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.generate.loadgen import (
+        LoadgenConfig,
+        build_workload,
+        run_loadgen,
+    )
+    from distllm_tpu.models import mistral
+
+    prefix = 'gen_load_'
+    if os.environ.get('DISTLLM_BENCH_LOAD', '1') in ('', '0'):
+        return {f'{prefix}skipped': 'DISTLLM_BENCH_LOAD=0'}
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+        )
+        # max_model_len 128 keeps the CPU-smoke compile ladder at four
+        # prefill buckets — warmup dominates this stage's fast-tier cost.
+        max_num_seqs, num_blocks, max_model_len, decode_steps = 4, 160, 128, 4
+        load_cfg = LoadgenConfig(
+            seed=0, num_requests=24, rate_rps=12.0, num_sessions=3,
+            warm_fraction=0.6, prefix_tokens=32, prompt_tokens=(8, 40),
+            output_tokens=(4, 16), vocab_size=model_cfg.vocab_size,
+        )
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+        max_num_seqs, num_blocks, max_model_len, decode_steps = (
+            32, 712, 512, 16
+        )
+        load_cfg = LoadgenConfig(
+            seed=0, num_requests=256, rate_rps=16.0, num_sessions=16,
+            warm_fraction=0.6, prefix_tokens=64, prompt_tokens=(32, 192),
+            output_tokens=(16, 96), vocab_size=model_cfg.vocab_size,
+        )
+    engine_cfg = EngineConfig(
+        block_size=16,
+        num_blocks=num_blocks,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        decode_steps=decode_steps,
+        pipeline_depth=2,
+        sampling_top_window=64,
+        enable_prefix_cache=True,
+        ttft_slo_s=2.0,
+        attribution=True,
+    )
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
+    engine, fallback_reason = _build_engine_with_fallback(
+        model_cfg,
+        engine_cfg,
+        lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+        [[1, 2, 3]],
+        SamplingParams(temperature=0.0, max_tokens=2),
+    )
+    warmup_secs = time.perf_counter() - warmup_start
+
+    workload = build_workload(load_cfg)
+    on = run_loadgen(engine, workload)
+    # Attribution OFF replay of the SAME workload on the SAME engine
+    # (greedy → the prefix cache being warm now cannot change tokens —
+    # the engine's cache-on/off identity guarantee): attribution must be
+    # pure host-side bookkeeping.
+    engine.attribution = False
+    off = run_loadgen(engine, workload)
+    engine.attribution = True
+    identical = on.tokens_by_request == off.tokens_by_request
+
+    out = {
+        f'{prefix}metric': 'open-loop load generation',
+        **on.to_fragment(prefix),
+        f'{prefix}tokens_identical': identical,
+        f'{prefix}attribution_off_tok_s': round(off.achieved_tok_s, 2),
+        f'{prefix}slo_s': engine_cfg.ttft_slo_s,
+        f'{prefix}attn_backend': engine.config.attn_backend,
+        f'{prefix}warmup_secs': round(warmup_secs, 1),
+        f'{prefix}device': str(jax.devices()[0].device_kind),
+        f'{prefix}workload': _workload_fingerprint(
+            {
+                'arrivals': [
+                    [a.at_s, list(a.prompt_ids), a.max_tokens, a.session]
+                    for a in workload
+                ],
+                'engine': {'max_num_seqs': max_num_seqs,
+                           'num_blocks': num_blocks,
+                           'decode_steps': decode_steps},
+            }
+        ),
+        **_cache_fields(prefix, cache_before),
+    }
+    if not identical:
+        out[f'{prefix}error'] = (
+            'attribution on/off token mismatch — attribution must be '
+            'pure host-side bookkeeping'
+        )
+    elif on.warm_prefix_hit_tokens <= 0:
+        out[f'{prefix}error'] = (
+            'no warm-prefix cache hits — the warm/cold session mix is '
+            'not exercising the prefix cache'
+        )
+    if fallback_reason:
+        out[f'{prefix}attn_fallback_reason'] = fallback_reason
+    return out
+
+
 def _stage_gen() -> dict:
     return _run_gen(None, 'gen_')
 
@@ -1011,7 +1143,8 @@ def _chip_peak_flops(device) -> float | None:
 # round-4 22-45 min outlier — runs last so a deadline truncates the most
 # expensive coverage first, never the headline metrics.
 STAGE_ORDER = (
-    'embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_spec', 'gen_q',
+    'embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_spec',
+    'gen_load', 'gen_q',
 )
 NOMINAL_BUDGET_S = {
     'embed': 1200.0,
@@ -1020,9 +1153,12 @@ NOMINAL_BUDGET_S = {
     'gen_prefix': 2700.0,
     'gen_mixed': 2700.0,
     'gen_spec': 2700.0,
+    'gen_load': 2700.0,
     'gen_q': 2700.0,
 }
-GEN_STAGES = frozenset({'gen', 'gen_q', 'gen_prefix', 'gen_mixed', 'gen_spec'})
+GEN_STAGES = frozenset(
+    {'gen', 'gen_q', 'gen_prefix', 'gen_mixed', 'gen_spec', 'gen_load'}
+)
 # Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
 # stages stop with ~5 min to spare even if the guess is exact, and the
 # SIGTERM handler is the backstop if the real budget is shorter.
@@ -1253,6 +1389,7 @@ def _run_stage_entry(stage: str) -> None:
         'gen_prefix': _stage_gen_prefix,
         'gen_mixed': _stage_gen_mixed,
         'gen_spec': _stage_gen_spec,
+        'gen_load': _stage_gen_load,
     }
     watchdog = None
     watchdog_s = float(os.environ.get('DISTLLM_BENCH_WATCHDOG_S', '300') or 0)
@@ -1277,7 +1414,7 @@ def main() -> None:
         '--stage',
         choices=[
             'embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix', 'gen_mixed',
-            'gen_spec',
+            'gen_spec', 'gen_load',
         ],
     )
     args = parser.parse_args()

@@ -23,8 +23,18 @@ Observability surface (docs/observability.md):
 - ``GET /debug/traces?limit=N`` — most recent spans from the trace ring;
 - ``GET /debug/flight?limit=N`` — most recent engine flight-recorder
   records (prefill/decode steps, request lifecycles, preemptions);
+- ``GET /debug/perfetto?limit=N`` — the flight + span rings rendered as a
+  Perfetto/``chrome://tracing`` trace-event JSON (open it at
+  https://ui.perfetto.dev), request-id-correlated tracks included;
 - ``GET /debug/bundle`` — dump a full debug bundle (flight ring + metrics
-  + traces) to disk and return the written paths.
+  + traces + perfetto.json) to disk and return the written paths.
+
+Request-scoped tracing: every ``POST /v1/chat/completions`` accepts an
+``X-Request-Id`` header (one is generated when absent), binds it around
+the whole retrieve/generate path (``observability.request_scope`` — spans
+and the engine's request lifecycle records carry it), and echoes it back
+both as the ``X-Request-Id`` response header and a ``request_id`` field in
+the completion payload.
 
 Generation requests run under an optional stall watchdog
 (``DISTLLM_WATCHDOG_S`` seconds, 0 = off): if the engine makes no
@@ -41,6 +51,7 @@ import argparse
 import asyncio
 import json
 import os
+import re
 import time
 import uuid
 
@@ -53,8 +64,22 @@ from distllm_tpu.observability import (
     get_trace_buffer,
     instruments,
     render_prometheus,
+    request_scope,
     span,
+    to_trace_events,
 )
+
+# Accepted inbound X-Request-Id shape; anything else (or nothing) gets a
+# generated id — a client header must not be able to smuggle arbitrary
+# bytes into trace attributes, flight records, and response headers.
+_REQUEST_ID_RE = re.compile(r'^[A-Za-z0-9._:-]{1,128}$')
+
+
+def _resolve_request_id(request) -> str:
+    header = (request.headers.get('X-Request-Id') or '').strip()
+    if _REQUEST_ID_RE.match(header):
+        return header
+    return f'req-{uuid.uuid4().hex[:16]}'
 
 
 def _debug_dir(kind: str) -> str:
@@ -67,12 +92,13 @@ def _debug_dir(kind: str) -> str:
     return os.path.join(base, f'{kind}_{stamp}_{os.getpid()}')
 
 
-def _completion_payload(model: str, content: str) -> dict:
+def _completion_payload(model: str, content: str, request_id: str) -> dict:
     return {
         'id': f'chatcmpl-{uuid.uuid4().hex[:24]}',
         'object': 'chat.completion',
         'created': int(time.time()),
         'model': model,
+        'request_id': request_id,
         'choices': [
             {
                 'index': 0,
@@ -107,8 +133,18 @@ def build_app(config: ChatAppConfig):
     for path in known_paths:
         instruments.HTTP_LATENCY.labels(path=path)
 
-    def answer(messages, top_k, score_threshold):
-        """Stateless per-request RAG (history comes from the client)."""
+    def answer(messages, top_k, score_threshold, request_id):
+        """Stateless per-request RAG (history comes from the client).
+
+        Runs inside ``request_scope(request_id)`` (bound HERE, in the
+        executor thread — ``run_in_executor`` does not carry the event
+        loop's context over): the retrieve/generate spans and the
+        engine's request lifecycle all pick up the propagated id.
+        """
+        with request_scope(request_id):
+            return _answer_in_scope(messages, top_k, score_threshold)
+
+    def _answer_in_scope(messages, top_k, score_threshold):
         latest = next(
             (m['content'] for m in reversed(messages) if m['role'] == 'user'),
             '',
@@ -151,9 +187,10 @@ def build_app(config: ChatAppConfig):
             body.get('score_threshold', config.retrieval_score_threshold)
         )
         model = body.get('model', 'distllm-tpu')
+        request_id = _resolve_request_id(request)
         loop = asyncio.get_running_loop()
         content = await loop.run_in_executor(
-            executor, answer, messages, top_k, score_threshold
+            executor, answer, messages, top_k, score_threshold, request_id
         )
         if body.get('stream'):
             # Single-delta SSE streaming (reference ``chat_server.py:168-270``).
@@ -161,6 +198,7 @@ def build_app(config: ChatAppConfig):
                 headers={
                     'Content-Type': 'text/event-stream',
                     'Cache-Control': 'no-cache',
+                    'X-Request-Id': request_id,
                 }
             )
             await response.prepare(request)
@@ -169,6 +207,7 @@ def build_app(config: ChatAppConfig):
                 'object': 'chat.completion.chunk',
                 'created': int(time.time()),
                 'model': model,
+                'request_id': request_id,
                 'choices': [
                     {
                         'index': 0,
@@ -183,7 +222,10 @@ def build_app(config: ChatAppConfig):
             await response.write(b'data: [DONE]\n\n')
             await response.write_eof()
             return response
-        return web.json_response(_completion_payload(model, content))
+        return web.json_response(
+            _completion_payload(model, content, request_id),
+            headers={'X-Request-Id': request_id},
+        )
 
     async def health(request: 'web.Request') -> 'web.Response':
         # In-flight includes this very request; report the others.
@@ -234,6 +276,36 @@ def build_app(config: ChatAppConfig):
             }
         )
 
+    async def perfetto(request: 'web.Request') -> 'web.Response':
+        try:
+            limit = int(request.query.get('limit', '2000'))
+        except ValueError:
+            return web.json_response(
+                {'error': {'message': 'limit must be an integer'}}, status=400
+            )
+        limit = max(1, limit)
+
+        def build() -> str:
+            # Rendering + sorting thousands of events is real CPU work;
+            # like bundle(), keep it off the event loop (default pool,
+            # not the single-worker engine executor).
+            doc = to_trace_events(
+                get_flight_recorder().snapshot(limit=limit),
+                [
+                    s.to_dict()
+                    for s in get_trace_buffer().snapshot(limit=limit)
+                    if s.end_ns is not None
+                ],
+            )
+            return json.dumps(doc)
+
+        loop = asyncio.get_running_loop()
+        body = await loop.run_in_executor(None, build)
+        return web.Response(
+            body=body.encode('utf-8'),
+            headers={'Content-Type': 'application/json'},
+        )
+
     async def bundle(request: 'web.Request') -> 'web.Response':
         directory = _debug_dir('bundle')
         # Default thread pool, NOT the single-worker engine executor: the
@@ -282,6 +354,7 @@ def build_app(config: ChatAppConfig):
     app.router.add_get('/metrics', metrics)
     app.router.add_get('/debug/traces', traces)
     app.router.add_get('/debug/flight', flight)
+    app.router.add_get('/debug/perfetto', perfetto)
     app.router.add_get('/debug/bundle', bundle)
     # Browser preflight for any path (CORS headers added by the middleware).
     app.router.add_route('OPTIONS', '/{tail:.*}', preflight)

@@ -29,6 +29,7 @@ place in HBM (no per-step cache copies).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import time
 from dataclasses import dataclass, field
@@ -124,6 +125,11 @@ class Request:
     t_admit: float = 0.0
     t_first_token: float = 0.0
     t_finish: float = 0.0
+    # Propagated request id (the server's X-Request-Id), captured from
+    # tracing.request_scope at add_request; carried on the 'request'
+    # flight record so one id correlates server spans, engine lifecycle,
+    # and the Perfetto request track (docs/observability.md).
+    trace_id: str | None = None
 
     @property
     def num_tokens(self) -> int:
@@ -331,6 +337,17 @@ class EngineConfig(BaseConfig):
     # the verify kernel is bit-identical in any dtype
     # (docs/speculative.md; the gen_spec bench stage asserts it).
     spec_draft_source: str = 'prompt_lookup'
+    # Serving-path attribution (docs/observability.md): per-window
+    # host/put/dispatch/fetch timing split on flight records,
+    # jax.profiler.TraceAnnotation labels on every dispatch kind, and the
+    # analytic roofline gauges (distllm_engine_mfu /
+    # distllm_engine_bandwidth_utilization). Pure host-side bookkeeping —
+    # token output is bit-identical on vs off (the gen_load bench stage
+    # asserts it). Off sheds the record fields, profiler annotations, and
+    # roofline math; the raw time.monotonic() reads at the dispatch sites
+    # stay (nanoseconds — gating them would complicate every window path
+    # for nothing measurable).
+    attribution: bool = True
     seed: int = 0
 
     @field_validator('spec_draft_source')
@@ -668,6 +685,29 @@ class LLMEngine:
         self._scatter_tokens = jax.jit(
             lambda carried, slot_idx, toks: carried.at[slot_idx].set(toks)
         )
+        # Serving-path attribution (docs/observability.md): a runtime-
+        # flippable flag (no compiled shapes depend on it), the analytic
+        # roofline cost model priced from the FINAL params (post-quant,
+        # post-relayout — the bytes that really stream), and per-kind
+        # accumulators behind roofline_summary(). Cost-model failures
+        # (exotic leaf types) disable the gauges, never the engine.
+        self.attribution = cfg.attribution
+        self._cost_model = None
+        self._roofline: dict[str, dict[str, float]] = {}
+        # Built unconditionally (a cheap metadata walk) so flipping
+        # self.attribution ON at runtime works even when the engine was
+        # constructed with attribution off.
+        try:
+            from distllm_tpu.observability.roofline import CostModel
+
+            self._cost_model = CostModel.from_params(
+                self.params, cfg.decode_steps,
+                # Param leaves report GLOBAL size/bytes under TP; the
+                # roofline scales the peaks by the mesh size to match.
+                num_devices=mesh.size if mesh is not None else 1,
+            )
+        except Exception as exc:
+            self.telemetry['roofline_fallback'] = repr(exc)[:300]
 
     def _put(self, x):
         """Host value → device array, replicated over the mesh under TP."""
@@ -1110,11 +1150,16 @@ class LLMEngine:
                 f'prompt needs {needed} KV blocks but the pool only has '
                 f'{self.kv.num_blocks - 1}; increase num_blocks'
             )
+        from distllm_tpu.observability.tracing import current_request_id
+
         request = Request(
             request_id=next(self._next_id),
             prompt_ids=list(prompt_ids),
             params=params or SamplingParams(),
             t_enqueue=time.monotonic(),
+            # Bound by the server's request_scope (X-Request-Id) when the
+            # add happens inside one; None for offline/batch callers.
+            trace_id=current_request_id(),
         )
         if (
             self.config.draft_k
@@ -1490,6 +1535,7 @@ class LLMEngine:
             lengths[i] = len(prompt)
             block_rows[i] = self._block_row(request.request_id)
 
+        t_host = time.monotonic()
         (
             ids_dev,
             mask_dev,
@@ -1497,17 +1543,20 @@ class LLMEngine:
             block_rows_dev,
             lengths_dev,
         ) = self._put_many(ids, mask, last_pos, block_rows, lengths)
-        last_logits, k_all, v_all = self._prefill(
-            self.params, ids_dev, mask_dev, last_pos_dev
-        )
-        self.kv.k, self.kv.v = self._write_prefill(
-            self.kv.k,
-            self.kv.v,
-            k_all,
-            v_all,
-            block_rows_dev,
-            lengths_dev,
-        )
+        t_put = time.monotonic()
+        with self._annotate('prefill'):
+            last_logits, k_all, v_all = self._prefill(
+                self.params, ids_dev, mask_dev, last_pos_dev
+            )
+            self.kv.k, self.kv.v = self._write_prefill(
+                self.kv.k,
+                self.kv.v,
+                k_all,
+                v_all,
+                block_rows_dev,
+                lengths_dev,
+            )
+        t_dispatch = time.monotonic()
         # Full prompt blocks just entered the paged cache — adopt them
         # into the prefix cache BEFORE emission (a max_tokens=1 request
         # finishes inside _emit_prefill, after which its row is gone).
@@ -1517,6 +1566,10 @@ class LLMEngine:
         self._record_step(
             'prefill', t_start, batch=len(requests),
             tokens=int(lengths.sum()),
+            **self._attribution_fields(
+                t_start, t_host, t_put, t_dispatch,
+                rids=[r.request_id for r in requests],
+            ),
         )
         return emitted
 
@@ -1671,6 +1724,7 @@ class LLMEngine:
         ids, positions, block_rows, context_lens, tail_lens = (
             self._span_host_arrays(spans, bucket, b)
         )
+        t_host = time.monotonic()
         (
             ids_dev,
             positions_dev,
@@ -1680,27 +1734,36 @@ class LLMEngine:
         ) = self._put_many(
             ids, positions, block_rows, context_lens, tail_lens
         )
-        last_logits, self.kv.k, self.kv.v = self._prefill_paged(
-            self.params,
-            ids_dev,
-            positions_dev,
-            self.kv.k,
-            self.kv.v,
-            block_rows_dev,
-            context_lens_dev,
-            tail_lens_dev,
+        t_put = time.monotonic()
+        with self._annotate('prefill'):
+            last_logits, self.kv.k, self.kv.v = self._prefill_paged(
+                self.params,
+                ids_dev,
+                positions_dev,
+                self.kv.k,
+                self.kv.v,
+                block_rows_dev,
+                context_lens_dev,
+                tail_lens_dev,
+            )
+        t_dispatch = time.monotonic()
+        attrib = self._attribution_fields(
+            t_start, t_host, t_put, t_dispatch,
+            rids=[r.request_id for r in requests],
         )
         chunk_tokens = int(tail_lens.sum())
         if not sample:
             self._record_step(
-                'prefill', t_start, batch=len(requests), tokens=chunk_tokens
+                'prefill', t_start, batch=len(requests),
+                tokens=chunk_tokens, **attrib,
             )
             return []
         for request in requests:
             self._insert_prompt_blocks(request)
         emitted = self._emit_prefill(requests, last_logits, b, defer_to)
         self._record_step(
-            'prefill', t_start, batch=len(requests), tokens=chunk_tokens
+            'prefill', t_start, batch=len(requests), tokens=chunk_tokens,
+            **attrib,
         )
         return emitted
 
@@ -1745,6 +1808,40 @@ class LLMEngine:
             self.sched.lend_prefix(rid, lent)
             request.num_borrowed_blocks = lent
 
+    def _annotate(self, kind: str):
+        """``jax.profiler.TraceAnnotation`` around a dispatch when
+        attribution is on: profiler captures (``DISTLLM_BENCH_PROFILE``)
+        then carry a ``distllm:<kind>`` host slice over every device
+        launch, tying XPlane device time back to engine step kinds."""
+        if not self.attribution:
+            return contextlib.nullcontext()
+        try:
+            return jax.profiler.TraceAnnotation(f'distllm:{kind}')
+        except Exception:  # pragma: no cover - profiler-less backends
+            return contextlib.nullcontext()
+
+    def _attribution_fields(
+        self, t_start, t_host, t_put, t_dispatch, *, fetch_s=None, rids=None,
+    ) -> dict:
+        """The device/host step split for one flight record (empty when
+        attribution is off): ``host_s`` (plan build), ``put_s``
+        (host→device transfer), ``dispatch_s`` (jit call; async backends
+        return before the device finishes), plus ``fetch_s`` (device→host
+        token fetch, where pipelined in-flight time surfaces) and the
+        participating ``rids`` when the caller knows them."""
+        if not self.attribution:
+            return {}
+        fields = {
+            'host_s': round(t_host - t_start, 6),
+            'put_s': round(t_put - t_host, 6),
+            'dispatch_s': round(t_dispatch - t_put, 6),
+        }
+        if fetch_s is not None:
+            fields['fetch_s'] = round(fetch_s, 6)
+        if rids is not None:
+            fields['rids'] = list(rids)
+        return fields
+
     def _record_step(self, kind: str, t_start: float, *, batch: int,
                      tokens: int, **extra) -> None:
         """One flight-ring record + metrics pair per engine step.
@@ -1754,11 +1851,44 @@ class LLMEngine:
         dispatch → host fetch, so pipelined in-flight time is included —
         the wall clock a stalled window would actually burn. ``extra``
         carries kind-specific fields (the ``mixed`` kind adds
-        prefill_tokens/prefill_rows).
+        prefill_tokens/prefill_rows; with attribution on, every kind adds
+        the host/put/dispatch/fetch timing split).
+
+        With attribution on, the analytic roofline prices the step
+        (observability/roofline.py) and the record carries ``mfu`` /
+        ``bw_util`` next to the raw fields, mirrored into the
+        ``distllm_engine_mfu`` / ``distllm_engine_bandwidth_utilization``
+        gauges and the per-kind ``roofline_summary()`` accumulators.
         """
         duration_s = time.monotonic() - t_start
         _metrics.ENGINE_STEPS.labels(kind=kind).inc()
         _metrics.ENGINE_STEP_SECONDS.labels(kind=kind).observe(duration_s)
+        if self._cost_model is not None and self.attribution:
+            cost = self._cost_model.step_cost(
+                kind,
+                tokens=tokens,
+                batch=batch,
+                draft_tokens=extra.get('draft_tokens', 0),
+                prefill_tokens=extra.get('prefill_tokens', 0),
+            )
+            if cost is not None:
+                mfu, bw_util = self._cost_model.utilization(cost, duration_s)
+                _metrics.ENGINE_MFU.labels(kind=kind).set(mfu)
+                _metrics.ENGINE_BW_UTIL.labels(kind=kind).set(bw_util)
+                acc = self._roofline.setdefault(
+                    kind,
+                    {'windows': 0.0, 'seconds': 0.0, 'flops': 0.0,
+                     'hbm_bytes': 0.0},
+                )
+                acc['windows'] += 1
+                acc['seconds'] += duration_s
+                acc['flops'] += cost.flops
+                acc['hbm_bytes'] += cost.hbm_bytes
+                extra = {
+                    **extra,
+                    'mfu': round(mfu, 5),
+                    'bw_util': round(bw_util, 5),
+                }
         usable = self.config.num_blocks - 1  # block 0 is reserved
         self.flight.record(
             kind,
@@ -1773,6 +1903,51 @@ class LLMEngine:
             ) if usable > 0 else 0.0,
             **extra,
         )
+
+    def roofline_snapshot(self) -> dict[str, dict[str, float]]:
+        """Copy of the raw per-kind roofline accumulators — pass a prior
+        snapshot to ``roofline_summary(baseline=...)`` to scope the
+        summary to just the windows recorded in between (how the loadgen
+        isolates its run from warmup traffic)."""
+        return {kind: dict(acc) for kind, acc in self._roofline.items()}
+
+    def roofline_summary(
+        self, baseline: dict[str, dict[str, float]] | None = None
+    ) -> dict[str, dict[str, float]]:
+        """Aggregate roofline view per window kind:
+        ``{kind: {windows, seconds, mfu, bw_util}}`` with mfu/bw_util the
+        time-weighted means (total flops/bytes over total seconds over
+        the device peaks) — what the ``gen_load`` bench stage checkpoints.
+        ``baseline`` (a prior :meth:`roofline_snapshot`) subtracts
+        earlier windows so the summary covers one measured interval.
+        Empty when the cost model was unavailable (and nothing
+        accumulates while attribution is off)."""
+        if self._cost_model is None:
+            return {}
+        out: dict[str, dict[str, float]] = {}
+        for kind, acc in self._roofline.items():
+            base = (baseline or {}).get(kind, {})
+            acc = {
+                key: value - base.get(key, 0.0)
+                for key, value in acc.items()
+            }
+            seconds = acc['seconds']
+            if seconds <= 0:
+                continue
+            out[kind] = {
+                'windows': int(acc['windows']),
+                'seconds': round(seconds, 4),
+                'mfu': round(
+                    acc['flops'] / seconds / self._cost_model.peak_flops, 5
+                ),
+                'bw_util': round(
+                    acc['hbm_bytes']
+                    / seconds
+                    / self._cost_model.peak_hbm_bytes,
+                    5,
+                ),
+            }
+        return out
 
     def _block_row(self, rid: int) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -1874,6 +2049,7 @@ class LLMEngine:
         """
         if self.config.draft_k:
             return self._dispatch_spec_window()
+        t_start = time.monotonic()
         k = self.config.decode_steps
         kmax = self._window_kmax()
         decode_rids = None
@@ -1958,7 +2134,9 @@ class LLMEngine:
         ]
         if chunk_plan:
             host_arrays.extend(self._build_chunk_arrays(chunk_plan))
+        t_host = time.monotonic()
         devs = self._put_many(*host_arrays)
+        t_put = time.monotonic()
         (
             ids_dev,
             override_dev,
@@ -1976,27 +2154,28 @@ class LLMEngine:
         chunk_tokens = None
         chunk_entries: list[tuple[int, int, int, int, bool]] = []
         if chunk_plan:
-            (
-                tokens,
-                self.kv.k,
-                self.kv.v,
-                last_ids,
-                chunk_tokens,
-            ) = self._mixed_window(
-                self.params,
-                ids_dev,
-                positions_dev,
-                context_lens_dev,
-                self.kv.k,
-                self.kv.v,
-                block_tables_dev,
-                steps_left_dev,
-                temperature_dev,
-                top_p_dev,
-                min_p_dev,
-                key,
-                *devs[9:],
-            )
+            with self._annotate('mixed'):
+                (
+                    tokens,
+                    self.kv.k,
+                    self.kv.v,
+                    last_ids,
+                    chunk_tokens,
+                ) = self._mixed_window(
+                    self.params,
+                    ids_dev,
+                    positions_dev,
+                    context_lens_dev,
+                    self.kv.k,
+                    self.kv.v,
+                    block_tables_dev,
+                    steps_left_dev,
+                    temperature_dev,
+                    top_p_dev,
+                    min_p_dev,
+                    key,
+                    *devs[9:],
+                )
             ridden = 0
             for i, (request, start, ntok) in enumerate(chunk_plan):
                 request.prefill_sent = start + ntok
@@ -2012,20 +2191,21 @@ class LLMEngine:
             _metrics.MIXED_PREFILL_TOKENS_PER_WINDOW.observe(ridden)
             _metrics.MIXED_PREFILL_ROWS.observe(len(chunk_plan))
         else:
-            tokens, self.kv.k, self.kv.v, last_ids = self._decode_window(
-                self.params,
-                ids_dev,
-                positions_dev,
-                context_lens_dev,
-                self.kv.k,
-                self.kv.v,
-                block_tables_dev,
-                steps_left_dev,
-                temperature_dev,
-                top_p_dev,
-                min_p_dev,
-                key,
-            )
+            with self._annotate('decode'):
+                tokens, self.kv.k, self.kv.v, last_ids = self._decode_window(
+                    self.params,
+                    ids_dev,
+                    positions_dev,
+                    context_lens_dev,
+                    self.kv.k,
+                    self.kv.v,
+                    block_tables_dev,
+                    steps_left_dev,
+                    temperature_dev,
+                    top_p_dev,
+                    min_p_dev,
+                    key,
+                )
         for _, rid, steps in plan:
             if steps:
                 self._unacked[rid] = self._unacked.get(rid, 0) + steps
@@ -2041,6 +2221,9 @@ class LLMEngine:
             't_dispatch': time.monotonic(),
             'chunk_tokens': chunk_tokens,
             'chunk_plan': chunk_entries,
+            # Attribution: the plan/put/dispatch split, completed with the
+            # fetch time when _process_window syncs the tokens.
+            'timing': (t_start, t_host, t_put, time.monotonic()),
         }
 
     # ------------------------------------------- speculative verify windows
@@ -2060,6 +2243,7 @@ class LLMEngine:
         record for ``_process_spec_window``, or ``_DRAIN`` when nothing
         can ride.
         """
+        t_start = time.monotonic()
         cfg = self.config
         draft_k = cfg.draft_k
         drafts_by_rid: dict[int, list[int]] = {}
@@ -2152,28 +2336,31 @@ class LLMEngine:
         ]
         if chunk_plan:
             host_arrays.extend(self._build_chunk_arrays(chunk_plan))
+        t_host = time.monotonic()
         devs = self._put_many(*host_arrays)
+        t_put = time.monotonic()
         self._key, key = jax.random.split(self._key)
         chunk_tokens = None
         chunk_entries: list[tuple[int, int, int, int, bool]] = []
         if chunk_plan:
-            tokens, self.kv.k, self.kv.v, chunk_tokens = (
-                self._spec_mixed_window(
-                    self.params,
-                    devs[0],  # span ids
-                    devs[1],  # span positions
-                    devs[3],  # context_lens
-                    self.kv.k,
-                    self.kv.v,
-                    devs[2],  # block tables
-                    devs[4],  # span_lens
-                    devs[5],
-                    devs[6],
-                    devs[7],
-                    key,
-                    *devs[8:],
+            with self._annotate('spec'):
+                tokens, self.kv.k, self.kv.v, chunk_tokens = (
+                    self._spec_mixed_window(
+                        self.params,
+                        devs[0],  # span ids
+                        devs[1],  # span positions
+                        devs[3],  # context_lens
+                        self.kv.k,
+                        self.kv.v,
+                        devs[2],  # block tables
+                        devs[4],  # span_lens
+                        devs[5],
+                        devs[6],
+                        devs[7],
+                        key,
+                        *devs[8:],
+                    )
                 )
-            )
             ridden = 0
             for i, (request, start, ntok) in enumerate(chunk_plan):
                 request.prefill_sent = start + ntok
@@ -2191,20 +2378,21 @@ class LLMEngine:
             _metrics.MIXED_PREFILL_TOKENS_PER_WINDOW.observe(ridden)
             _metrics.MIXED_PREFILL_ROWS.observe(len(chunk_plan))
         else:
-            tokens, self.kv.k, self.kv.v, _ = self._spec_window(
-                self.params,
-                devs[0],
-                devs[1],
-                devs[3],
-                self.kv.k,
-                self.kv.v,
-                devs[2],
-                devs[4],
-                devs[5],
-                devs[6],
-                devs[7],
-                key,
-            )
+            with self._annotate('spec'):
+                tokens, self.kv.k, self.kv.v, _ = self._spec_window(
+                    self.params,
+                    devs[0],
+                    devs[1],
+                    devs[3],
+                    self.kv.k,
+                    self.kv.v,
+                    devs[2],
+                    devs[4],
+                    devs[5],
+                    devs[6],
+                    devs[7],
+                    key,
+                )
         ndrafted = sum(len(d) for _, _, d in plan)
         self._stats['spec_windows'] += 1
         self._stats['spec_draft_tokens'] += ndrafted
@@ -2219,6 +2407,7 @@ class LLMEngine:
             'chunk_plan': chunk_entries,
             't_dispatch': time.monotonic(),
             'last_ids': None,
+            'timing': (t_start, t_host, t_put, time.monotonic()),
         }
 
     def _process_spec_window(self, window: dict) -> list[tuple[int, int]]:
@@ -2238,7 +2427,10 @@ class LLMEngine:
         (the rejected K/V needs no rollback — it sits at positions every
         later dispatch overwrites before attending or masks out).
         """
-        tokens = np.asarray(window['tokens'])  # [B, S]
+        t_fetch = time.monotonic()
+        with self._annotate('fetch'):
+            tokens = np.asarray(window['tokens'])  # [B, S]
+        fetch_s = time.monotonic() - t_fetch
         emitted: list[tuple[int, int]] = []
         drafted = accepted = rows = 0
         for slot, rid, drafts in window['plan']:
@@ -2273,6 +2465,11 @@ class LLMEngine:
                 n for *_, n, _ in chunk_entries
             )
             extra['prefill_rows'] = len(chunk_entries)
+        if window.get('timing'):
+            ts, th, tp, td = window['timing']
+            extra.update(self._attribution_fields(
+                ts, th, tp, td, fetch_s=fetch_s,
+            ))
         self._record_step(
             'spec', window['t_dispatch'], batch=rows, tokens=len(emitted),
             **extra,
@@ -2310,7 +2507,10 @@ class LLMEngine:
         ``_process_spec_window``."""
         if window.get('spec'):
             return self._process_spec_window(window)
-        tokens = np.asarray(window['tokens'])  # [K, B]
+        t_fetch = time.monotonic()
+        with self._annotate('fetch'):
+            tokens = np.asarray(window['tokens'])  # [K, B]
+        fetch_s = time.monotonic() - t_fetch
         emitted: list[tuple[int, int]] = []
         chunk_entries = window.get('chunk_plan') or []
         if 't_dispatch' in window:  # prefill fetch records carry no clock
@@ -2320,6 +2520,11 @@ class LLMEngine:
                     'prefill_tokens': sum(n for *_, n, _ in chunk_entries),
                     'prefill_rows': len(chunk_entries),
                 }
+            if window.get('timing'):
+                ts, th, tp, td = window['timing']
+                extra.update(self._attribution_fields(
+                    ts, th, tp, td, fetch_s=fetch_s,
+                ))
             self._record_step(
                 'mixed' if chunk_entries else 'decode',
                 window['t_dispatch'],
@@ -2547,12 +2752,17 @@ class LLMEngine:
         self.flight.record(
             'request',
             request_id=request.request_id,
+            trace_id=request.trace_id,
             prompt_tokens=len(request.prompt_ids),
             output_tokens=n_out,
             queue_wait_s=round(request.t_admit - request.t_enqueue, 6)
             if request.t_admit else None,
             ttft_s=round(ttft_s, 6) if ttft_s is not None else None,
             tpot_s=round(tpot_s, 6) if tpot_s is not None else None,
+            # Full enqueue -> finish extent: what lets the Perfetto
+            # exporter reconstruct the request's wall-clock slice from
+            # this one record (t_wall is the finish instant).
+            e2e_s=round(request.t_finish - request.t_enqueue, 6),
             cached_tokens=request.num_cached_tokens,
         )
 

@@ -1,0 +1,336 @@
+"""Open-loop load generator for the serving engine (ISSUE 10 tentpole).
+
+An *open-loop* generator submits requests on a fixed arrival schedule,
+regardless of how fast the system drains them — the only arrival model
+under which tail latency means anything (a closed loop self-throttles and
+hides queueing collapse; "From Attention to Disaggregation", PAPERS.md).
+This module is the measurement harness the disaggregated-routing and
+SLO-scheduling roadmap items build on:
+
+- :func:`build_workload` — a fully deterministic seeded workload: Poisson
+  arrivals (exponential inter-arrival gaps at ``rate_rps``), a
+  configurable session population where *warm* requests share their
+  session's block-aligned prompt prefix (prefix-cache hits after the
+  session's first request) and *cold* requests are unique, and per-request
+  output budgets. Same seed → same workload, byte for byte — what makes
+  the attribution on/off A/B and cross-run comparisons meaningful.
+- :func:`run_loadgen` — drives a built engine through the schedule with
+  ``engine.step()`` (arrivals injected the moment their time comes, even
+  mid-stream at full batch) and reports TTFT / TPOT / queue-wait
+  p50/p95/p99 via :func:`~distllm_tpu.observability.metrics.
+  quantile_from_cumulative` over the request-lifecycle histogram deltas,
+  goodput (SLO accounting + per-window throughput percentiles from the
+  flight ring), warm-prefix hit counts, and the per-window-kind
+  MFU / bandwidth-utilization summary.
+
+Used by the ``gen_load`` bench stage (``DISTLLM_BENCH_LOAD=0`` skips) and
+the ``scripts/loadgen.py`` CLI; knobs documented in
+``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from distllm_tpu.observability import instruments as _metrics
+from distllm_tpu.observability.metrics import quantile_from_cumulative
+
+_QUANTILES = (0.50, 0.95, 0.99)
+_LIFECYCLE_HISTOGRAMS = {
+    'ttft': _metrics.REQUEST_TTFT,
+    'tpot': _metrics.REQUEST_TPOT,
+    'queue_wait': _metrics.REQUEST_QUEUE_WAIT,
+}
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scheduled request: offset from workload start + its payload."""
+
+    at_s: float
+    prompt_ids: tuple[int, ...]
+    max_tokens: int
+    session: int | None  # warm session id, None = cold (unique prompt)
+    temperature: float = 0.0
+
+
+@dataclass
+class LoadgenConfig:
+    """Workload shape. Defaults are the CPU-smoke scale; chip runs raise
+    ``num_requests``/``rate_rps`` and the token ranges."""
+
+    seed: int = 0
+    num_requests: int = 32
+    # Poisson arrival rate (requests/second). Offered load, not achieved:
+    # the open loop keeps submitting on schedule even when the engine
+    # falls behind — queue-wait percentiles are the point.
+    rate_rps: float = 8.0
+    # Warm/cold prefix mix: each warm request joins one of num_sessions
+    # sessions and shares that session's prefix_tokens-token prompt
+    # prefix (block-aligned → prefix-cache hits after the session's
+    # first request); cold requests are globally unique.
+    num_sessions: int = 4
+    warm_fraction: float = 0.5
+    prefix_tokens: int = 32
+    prompt_tokens: tuple[int, int] = (8, 48)   # cold/tail length range
+    output_tokens: tuple[int, int] = (4, 24)
+    vocab_size: int = 2048
+    temperature: float = 0.0  # greedy: deterministic across A/B arms
+
+
+def build_workload(cfg: LoadgenConfig) -> list[Arrival]:
+    """Deterministic seeded open-loop workload (see class docs)."""
+    if cfg.num_requests < 1:
+        raise ValueError('num_requests must be >= 1')
+    if cfg.rate_rps <= 0:
+        raise ValueError('rate_rps must be > 0')
+    rng = np.random.default_rng(cfg.seed)
+    arrivals_at = np.cumsum(
+        rng.exponential(1.0 / cfg.rate_rps, size=cfg.num_requests)
+    )
+    prefixes = [
+        tuple(
+            int(t)
+            for t in rng.integers(1, cfg.vocab_size, size=cfg.prefix_tokens)
+        )
+        for _ in range(max(1, cfg.num_sessions))
+    ]
+    lo, hi = cfg.prompt_tokens
+    out_lo, out_hi = cfg.output_tokens
+    workload: list[Arrival] = []
+    for at in arrivals_at:
+        tail = tuple(
+            int(t)
+            for t in rng.integers(
+                1, cfg.vocab_size, size=int(rng.integers(lo, hi + 1))
+            )
+        )
+        session: int | None = None
+        if rng.random() < cfg.warm_fraction:
+            session = int(rng.integers(len(prefixes)))
+            prompt = prefixes[session] + tail
+        else:
+            prompt = tail
+        workload.append(
+            Arrival(
+                at_s=float(at),
+                prompt_ids=prompt,
+                max_tokens=int(rng.integers(out_lo, out_hi + 1)),
+                session=session,
+                temperature=cfg.temperature,
+            )
+        )
+    return workload
+
+
+@dataclass
+class LoadReport:
+    """Everything one loadgen run measured. ``percentiles`` maps
+    ``'<metric>_p<q>'`` (histogram-estimated); ``tokens_by_request``
+    preserves emission order per request for A/B identity checks."""
+
+    requests: int
+    tokens: int
+    elapsed_s: float
+    offered_rps: float | None
+    achieved_tok_s: float
+    percentiles: dict[str, float | None]
+    window_tok_s: dict[str, float | None]
+    goodput_tokens: int
+    goodput_frac: float | None
+    slo_met: int
+    slo_missed: int
+    warm_prefix_hit_tokens: int
+    warm_requests: int
+    cold_requests: int
+    roofline: dict[str, dict[str, float]]
+    tokens_by_request: list[list[int]] = field(default_factory=list)
+
+    def to_fragment(self, prefix: str) -> dict:
+        """Flatten into ``{prefix}key`` fields for a bench stage record."""
+        out = {
+            f'{prefix}requests': self.requests,
+            f'{prefix}tokens': self.tokens,
+            f'{prefix}elapsed_s': round(self.elapsed_s, 3),
+            f'{prefix}offered_rps': (
+                round(self.offered_rps, 3)
+                if self.offered_rps is not None else None
+            ),
+            f'{prefix}tok_s': round(self.achieved_tok_s, 2),
+            f'{prefix}goodput_tokens': self.goodput_tokens,
+            f'{prefix}goodput_frac': self.goodput_frac,
+            f'{prefix}slo_met': self.slo_met,
+            f'{prefix}slo_missed': self.slo_missed,
+            f'{prefix}warm_prefix_hit_tokens': self.warm_prefix_hit_tokens,
+            f'{prefix}warm_requests': self.warm_requests,
+            f'{prefix}cold_requests': self.cold_requests,
+        }
+        for key, value in self.percentiles.items():
+            out[f'{prefix}{key}'] = (
+                round(value, 6) if value is not None else None
+            )
+        for key, value in self.window_tok_s.items():
+            out[f'{prefix}goodput_{key}'] = (
+                round(value, 2) if value is not None else None
+            )
+        for kind, stats in self.roofline.items():
+            out[f'{prefix}mfu_{kind}'] = stats.get('mfu')
+            out[f'{prefix}bw_util_{kind}'] = stats.get('bw_util')
+        return out
+
+
+def _exact_percentiles(values: list[float]) -> dict[str, float | None]:
+    if not values:
+        return {f'p{int(q * 100)}': None for q in _QUANTILES}
+    arr = np.asarray(values, dtype=np.float64)
+    return {
+        f'p{int(q * 100)}': float(np.percentile(arr, q * 100))
+        for q in _QUANTILES
+    }
+
+
+def run_loadgen(
+    engine, workload: list[Arrival], *, poll_sleep_s: float = 0.005
+) -> LoadReport:
+    """Drive ``engine`` through ``workload`` open-loop and measure.
+
+    The engine should be warmed (compiles inside the run would poison
+    every latency percentile) and, for the warm-prefix mix to mean
+    anything, built with ``enable_prefix_cache=True``. Greedy workloads
+    (``temperature=0``) produce identical token streams across repeat
+    runs on equal engine state — the attribution A/B relies on it.
+    """
+    from distllm_tpu.generate.engine.engine import SamplingParams
+
+    schedule = sorted(workload, key=lambda a: a.at_s)
+    hist_before = {
+        name: hist.cumulative_counts()
+        for name, hist in _LIFECYCLE_HISTOGRAMS.items()
+    }
+    stats_before = {
+        key: int(engine._stats.get(key, 0))
+        for key in (
+            'prefix_hit_tokens', 'goodput_tokens', 'slo_met', 'slo_missed',
+        )
+    }
+    flight_total_before = engine.flight.total_recorded
+    roofline_before = engine.roofline_snapshot()
+
+    tokens_by_rid: dict[int, list[int]] = {}
+    order: list[int] = []
+    next_i = 0
+    t0 = time.monotonic()
+    while next_i < len(schedule) or engine.has_unfinished:
+        now = time.monotonic() - t0
+        while next_i < len(schedule) and schedule[next_i].at_s <= now:
+            arrival = schedule[next_i]
+            next_i += 1
+            rid = engine.add_request(
+                list(arrival.prompt_ids),
+                SamplingParams(
+                    temperature=arrival.temperature,
+                    max_tokens=arrival.max_tokens,
+                ),
+            )
+            # Coordinated-omission correction: if this arrival's
+            # scheduled instant passed while a blocking step() held the
+            # loop, add_request stamped a LATE t_enqueue — measuring
+            # from it would erase exactly the schedule-relative queueing
+            # an open loop exists to expose. Re-anchor the lifecycle
+            # clock to the scheduled arrival, so every downstream
+            # TTFT/queue-wait/e2e observation (histograms included) is
+            # schedule-relative.
+            engine._requests[rid].t_enqueue = t0 + arrival.at_s
+            tokens_by_rid[rid] = []
+            order.append(rid)
+        if engine.has_unfinished:
+            for rid, tok in engine.step():
+                tokens_by_rid.setdefault(rid, []).append(tok)
+        elif next_i < len(schedule):
+            time.sleep(
+                min(poll_sleep_s, max(0.0, schedule[next_i].at_s - now))
+            )
+    elapsed_s = time.monotonic() - t0
+    # step()-driven runs leave finished requests parked in the engine's
+    # finished map (generate_ids is what normally pops them); drop this
+    # run's entries so back-to-back loadgen arms don't accumulate them.
+    for rid in order:
+        engine._finished.pop(rid, None)
+
+    percentiles: dict[str, float | None] = {}
+    for name, hist in _LIFECYCLE_HISTOGRAMS.items():
+        after = hist.cumulative_counts()
+        delta = [a - b for a, b in zip(after, hist_before[name])]
+        for q in _QUANTILES:
+            percentiles[f'{name}_p{int(q * 100)}'] = quantile_from_cumulative(
+                hist.buckets, delta, q
+            )
+
+    # Per-request goodput rate over THIS run's requests: output tokens
+    # over enqueue→finish wall time, counting only requests that met the
+    # TTFT SLO when one is configured (all requests otherwise) — the
+    # distribution of service rate the system actually *delivered*,
+    # flight-ring sourced. The ring may have evicted the oldest records
+    # of a very long run; percentiles then cover the retained tail (the
+    # ring is 4096 records deep).
+    new_records = engine.flight.snapshot()
+    grew = engine.flight.total_recorded - flight_total_before
+    new_records = new_records[-grew:] if grew else []
+    slo_s = float(getattr(engine.config, 'ttft_slo_s', 0.0) or 0.0)
+    goodput_rates = [
+        record['output_tokens'] / record['e2e_s']
+        for record in new_records
+        if record.get('kind') == 'request'
+        and record.get('e2e_s')
+        and record.get('output_tokens')
+        and (
+            slo_s <= 0
+            or (record.get('ttft_s') is not None
+                and record['ttft_s'] <= slo_s)
+        )
+    ]
+    window_tok_s = {
+        f'tok_s_{k}': v for k, v in _exact_percentiles(goodput_rates).items()
+    }
+
+    total_tokens = sum(len(v) for v in tokens_by_rid.values())
+    met = int(engine._stats.get('slo_met', 0)) - stats_before['slo_met']
+    missed = (
+        int(engine._stats.get('slo_missed', 0)) - stats_before['slo_missed']
+    )
+    goodput_tokens = (
+        int(engine._stats.get('goodput_tokens', 0))
+        - stats_before['goodput_tokens']
+    )
+    warm = sum(1 for a in schedule if a.session is not None)
+    # N arrivals span N-1 inter-arrival gaps; a single-request workload
+    # has no meaningful rate (None, not inf — the report must stay
+    # strict-JSON serializable).
+    span = schedule[-1].at_s - schedule[0].at_s if len(schedule) > 1 else 0.0
+    return LoadReport(
+        requests=len(schedule),
+        tokens=total_tokens,
+        elapsed_s=elapsed_s,
+        offered_rps=(len(schedule) - 1) / span if span > 0 else None,
+        achieved_tok_s=total_tokens / elapsed_s if elapsed_s > 0 else 0.0,
+        percentiles=percentiles,
+        window_tok_s=window_tok_s,
+        goodput_tokens=goodput_tokens,
+        goodput_frac=(
+            goodput_tokens / total_tokens if total_tokens and (met + missed)
+            else None
+        ),
+        slo_met=met,
+        slo_missed=missed,
+        warm_prefix_hit_tokens=(
+            int(engine._stats.get('prefix_hit_tokens', 0))
+            - stats_before['prefix_hit_tokens']
+        ),
+        warm_requests=warm,
+        cold_requests=len(schedule) - warm,
+        roofline=engine.roofline_summary(baseline=roofline_before),
+        tokens_by_request=[tokens_by_rid[rid] for rid in order],
+    )

@@ -12,7 +12,13 @@ Three layers, all dependency-free:
   ``log_event`` stdout funnel;
 - :mod:`~distllm_tpu.observability.flight` — the flight-recorder layer
   (ISSUE 3 tentpole): bounded per-engine-step ring, stall watchdog, debug
-  bundles, crash-proof ``RunRecord`` + ``Deadline`` for the bench contract.
+  bundles, crash-proof ``RunRecord`` + ``Deadline`` for the bench contract;
+- :mod:`~distllm_tpu.observability.perfetto` — Perfetto/Chrome trace-event
+  export of the flight + span rings and per-request lifecycles (ISSUE 10
+  tentpole; ``GET /debug/perfetto``, ``perfetto.json`` in bundles);
+- :mod:`~distllm_tpu.observability.roofline` — the analytic FLOPs/bytes
+  cost model behind ``distllm_engine_mfu`` and the weight-stream
+  bandwidth-utilization gauges.
 
 ``aggregate`` (imported lazily to avoid a cycle with ``timer``) rolls
 multi-host ``[timer]`` logs into one stats table. Metric names and
@@ -37,19 +43,29 @@ from distllm_tpu.observability.metrics import (
     MetricsRegistry,
     get_registry,
     log_buckets,
+    quantile_from_cumulative,
     render_prometheus,
 )
+from distllm_tpu.observability.perfetto import (
+    merge_host_traces,
+    to_trace_events,
+    validate_trace_events,
+)
+from distllm_tpu.observability.roofline import CostModel, device_peaks
 from distllm_tpu.observability.tracing import (
     Span,
     TraceBuffer,
     begin_span,
+    current_request_id,
     dump_traces,
     end_span,
     get_trace_buffer,
+    request_scope,
     span,
 )
 
 __all__ = [
+    'CostModel',
     'Counter',
     'Deadline',
     'FlightRecorder',
@@ -61,6 +77,8 @@ __all__ = [
     'StallWatchdog',
     'TraceBuffer',
     'begin_span',
+    'current_request_id',
+    'device_peaks',
     'dump_debug_bundle',
     'dump_traces',
     'end_span',
@@ -69,6 +87,11 @@ __all__ = [
     'get_trace_buffer',
     'log_buckets',
     'log_event',
+    'merge_host_traces',
+    'quantile_from_cumulative',
     'render_prometheus',
+    'request_scope',
     'span',
+    'to_trace_events',
+    'validate_trace_events',
 ]

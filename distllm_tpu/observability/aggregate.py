@@ -1,4 +1,4 @@
-"""Roll up multi-host worker logs into one stats table.
+"""Roll up multi-host worker logs into one stats table (and one trace).
 
 Fabric workers on every TPU host emit ``[timer]`` lines (see ``timer.py``)
 into their own stdout/log files, and every process can dump its span ring
@@ -6,14 +6,21 @@ as JSONL (``observability.dump_traces``, the bench debug bundles'
 ``traces.jsonl``/``flight.jsonl``). This module merges any number of those
 captures — both formats, freely mixed — into a single ``{tags: TimeStats}``
 view, the multi-host aggregation the reference could only do by hand, and
-renders it as a fixed-width table whose columns (count / total / mean /
-p50 / p95 / max) match what ``distllm_stage_duration_seconds`` exposes
-over ``/metrics``.
+renders it as a fixed-width table whose cross-host percentile columns
+(count / total / mean / p50 / p95 / p99 / max) match what
+``distllm_stage_duration_seconds`` exposes over ``/metrics``.
+
+``--perfetto OUT.json`` additionally merges every input's flight-JSONL and
+span-JSONL records into ONE combined Perfetto/Chrome trace with a process
+group per input file (``observability.perfetto.merge_host_traces``) — the
+multi-host timeline view: open it at https://ui.perfetto.dev and read
+cross-host skew straight off the shared clock.
 
 CLI::
 
     python -m distllm_tpu.observability.aggregate run/logs/*.txt \\
-        run/bundles/*/traces.jsonl
+        run/bundles/*/traces.jsonl \\
+        run/bundles/*/flight.jsonl --perfetto combined.json
 """
 
 from __future__ import annotations
@@ -99,7 +106,8 @@ def aggregate_logs(paths: list[str | Path]) -> dict[tuple[str, ...], object]:
 
 def format_stats_table(stats: dict[tuple[str, ...], object]) -> str:
     """Fixed-width table, one row per tag set, sorted by total time desc."""
-    header = ('tags', 'count', 'total_s', 'mean_s', 'p50_s', 'p95_s', 'max_s')
+    header = ('tags', 'count', 'total_s', 'mean_s', 'p50_s', 'p95_s',
+              'p99_s', 'max_s')
     rows = [header]
     ordered = sorted(
         stats.values(), key=lambda s: s.total_s, reverse=True
@@ -113,6 +121,7 @@ def format_stats_table(stats: dict[tuple[str, ...], object]) -> str:
                 f'{entry.mean_s:.3f}',
                 f'{entry.p50_s:.3f}',
                 f'{entry.p95_s:.3f}',
+                f'{entry.p99_s:.3f}',
                 f'{entry.max_s:.3f}',
             )
         )
@@ -127,13 +136,69 @@ def format_stats_table(stats: dict[tuple[str, ...], object]) -> str:
     return '\n'.join(lines)
 
 
+def load_host_capture(path: str | Path) -> tuple[list[dict], list[dict]]:
+    """Split one JSONL capture into ``(flight_records, span_dicts)``.
+
+    Flight records carry ``kind``; span dumps carry ``name``/``span_id``.
+    A file may freely mix both (a concatenated bundle); torn lines and
+    non-JSON lines (``[timer]`` text) are skipped.
+    """
+    flight: list[dict] = []
+    spans: list[dict] = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line.startswith('{'):
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn final line from a killed process
+        if not isinstance(record, dict):
+            continue
+        if 'kind' in record:
+            flight.append(record)
+        elif 'span_id' in record or 'start_ns' in record:
+            spans.append(record)
+    return flight, spans
+
+
+def write_combined_perfetto(
+    paths: list[str | Path], out: str | Path
+) -> int:
+    """Merge every input's flight/span JSONL records into one Perfetto
+    trace (a process group per input file, shared time origin); returns
+    how many inputs contributed renderable records."""
+    from distllm_tpu.observability.perfetto import merge_host_traces
+
+    hosts = []
+    for path in paths:
+        flight, spans = load_host_capture(path)
+        if flight or spans:
+            hosts.append((Path(path).name, flight, spans))
+    doc = merge_host_traces(hosts)
+    Path(out).write_text(json.dumps(doc))
+    return len(hosts)
+
+
 def main(argv: list[str] | None = None) -> int:
     from distllm_tpu.observability.instruments import log_event
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('logs', nargs='+', type=Path, help='worker log files')
+    parser.add_argument(
+        '--perfetto', type=Path, default=None, metavar='OUT.json',
+        help='also merge flight/span JSONL inputs into one combined '
+             'Perfetto trace (per-host track groups)',
+    )
     args = parser.parse_args(argv)
     stats = aggregate_logs(args.logs)
+    if args.perfetto is not None:
+        contributed = write_combined_perfetto(args.logs, args.perfetto)
+        log_event(
+            f'[aggregate] wrote combined Perfetto trace for {contributed} '
+            f'host capture(s) to {args.perfetto}',
+            component='aggregate',
+        )
     if not stats:
         log_event(
             f'No [timer] lines found in {len(args.logs)} files',

@@ -158,6 +158,21 @@ def dump_debug_bundle(
         paths['traces'] = str(traces_path)
     except Exception:
         pass
+    # Perfetto/Chrome trace of the same state: drop flight.jsonl's raw
+    # rings into https://ui.perfetto.dev without any conversion step —
+    # the post-mortem view of where the dying process's time went.
+    perfetto_path = directory / 'perfetto.json'
+    try:
+        from distllm_tpu.observability.perfetto import dump_trace
+
+        dump_trace(
+            perfetto_path,
+            recorder.snapshot(),
+            [s.to_dict() for s in get_trace_buffer().snapshot()],
+        )
+        paths['perfetto'] = str(perfetto_path)
+    except Exception:
+        pass
     # Optional device-memory capture: only when jax is already imported
     # (importing it here could initialize a backend inside a dying
     # process) and the backend supports the profiler.

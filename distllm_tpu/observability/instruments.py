@@ -202,11 +202,29 @@ ENGINE_STEP_SECONDS = _registry.histogram(
     labelnames=('kind',),
 )
 
+# ------------------------------------------- roofline / MFU attribution
+ENGINE_MFU = _registry.gauge(
+    'distllm_engine_mfu',
+    'Model FLOPs utilization of the most recent engine step of each kind: '
+    'analytic matmul FLOPs (2 x n_params per scored position, '
+    'observability/roofline.py) over wall time and the chip bf16 peak.',
+    labelnames=('kind',),
+)
+ENGINE_BW_UTIL = _registry.gauge(
+    'distllm_engine_bandwidth_utilization',
+    'Weight-stream HBM bandwidth utilization of the most recent engine '
+    'step of each kind: weight bytes read (decode re-reads the full set '
+    'every scan step) over wall time and the chip HBM peak.',
+    labelnames=('kind',),
+)
+
 # Pre-create the fixed label sets so the full request-lifecycle schema is
 # present in the very first scrape, before any traffic.
 for _kind in ('prefill', 'decode', 'mixed', 'spec'):
     ENGINE_STEPS.labels(kind=_kind)
     ENGINE_STEP_SECONDS.labels(kind=_kind)
+    ENGINE_MFU.labels(kind=_kind)
+    ENGINE_BW_UTIL.labels(kind=_kind)
 
 # Catalog of FlightRecorder record kinds, mirroring the distllm_* metric-
 # name catalog above: every ``kind`` the package ever passes to
@@ -226,6 +244,20 @@ FLIGHT_KINDS = frozenset({
 })
 for _outcome in ('met', 'missed'):
     REQUEST_SLO.labels(outcome=_outcome)
+
+# Catalog of Perfetto/Chrome trace-event categories, mirroring the
+# distllm_* metric-name and FLIGHT_KINDS catalogs: every ``cat`` the
+# trace-event exporter (observability/perfetto.py) emits must be listed
+# here (enforced by tests/test_lint.py). A category minted at a call site
+# would fragment the trace schema that Perfetto queries, the exporter
+# validator, and downstream tooling filter on.
+TRACE_EVENT_CATEGORIES = frozenset({
+    'engine_step',   # one engine dispatch slice on its window-kind track
+    'engine_event',  # instant marks (preemptions, scheduler events)
+    'host_gap',      # idle gap between consecutive engine windows
+    'request',       # per-request lifecycle slice + nested ttft/queue_wait
+    'span',          # trace-ring spans (server middleware, RAG, stages)
+})
 
 # -------------------------------------------------- watchdog / debug bundle
 WATCHDOG_STALLS = _registry.counter(

@@ -62,6 +62,42 @@ def log_buckets(
 DEFAULT_DURATION_BUCKETS = log_buckets(1e-4, 100.0)
 
 
+def quantile_from_cumulative(
+    buckets: tuple[float, ...], cumulative: list[int], q: float
+) -> float | None:
+    """Quantile estimate by linear interpolation over cumulative bucket
+    counts (the ``histogram_quantile`` estimator, so numbers read off a
+    loadgen report match what the same expression over ``/metrics`` would
+    say). ``cumulative`` has ``len(buckets) + 1`` entries, the last being
+    the +Inf bucket. Returns ``None`` on an empty histogram. Ranks that
+    land in the +Inf bucket clamp to the highest finite bound — an
+    estimator cannot invent an upper edge the ladder never recorded.
+
+    Also the delta-quantile building block: subtract two
+    ``cumulative_counts()`` snapshots element-wise and pass the result, and
+    the estimate covers only the observations between them (how the
+    ``gen_load`` bench stage isolates its own traffic from warmup's).
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f'quantile must be in [0, 1], got {q}')
+    total = cumulative[-1]
+    if total <= 0:
+        return None
+    rank = q * total
+    for i, count in enumerate(cumulative):
+        if count >= rank and count > 0:
+            if i >= len(buckets):  # +Inf bucket: clamp to last finite edge
+                return float(buckets[-1])
+            lo = buckets[i - 1] if i > 0 else 0.0
+            prev = cumulative[i - 1] if i > 0 else 0
+            in_bucket = count - prev
+            if in_bucket <= 0:
+                return float(buckets[i])
+            frac = (rank - prev) / in_bucket
+            return float(lo + (buckets[i] - lo) * frac)
+    return float(buckets[-1])
+
+
 def _escape_label_value(value: str) -> str:
     return (
         value.replace('\\', '\\\\').replace('"', '\\"').replace('\n', '\\n')
@@ -164,6 +200,13 @@ class _HistogramChild:
                 running += n
                 out.append(running)
             return out
+
+    def quantile(self, q: float) -> float | None:
+        """Estimated ``q``-quantile of the observed distribution
+        (:func:`quantile_from_cumulative` over this child's counts)."""
+        return quantile_from_cumulative(
+            self.buckets, self.cumulative_counts(), q
+        )
 
 
 class _Metric:
@@ -284,6 +327,19 @@ class Histogram(_Metric):
     @property
     def sum(self) -> float:
         return self._default_child().sum
+
+    def quantile(self, q: float) -> float | None:
+        """Estimated ``q``-quantile (linear interpolation over cumulative
+        bucket counts; ``None`` while the histogram is empty). Labeled
+        histograms expose the same method on each ``labels(...)`` child."""
+        return self._default_child().quantile(q)
+
+    def cumulative_counts(self) -> list[int]:
+        """Cumulative bucket counts of the unlabeled series — snapshot
+        two of these and difference them element-wise into
+        :func:`quantile_from_cumulative` to get quantiles over just the
+        observations in between (the loadgen report does)."""
+        return self._default_child().cumulative_counts()
 
 
 class MetricsRegistry:

@@ -17,6 +17,7 @@ logs.
 
 from __future__ import annotations
 
+import contextvars
 import itertools
 import json
 import threading
@@ -28,6 +29,40 @@ from pathlib import Path
 
 _span_ids = itertools.count(1)
 _local = threading.local()
+
+# Request-scoped trace propagation (docs/observability.md): the chat server
+# binds the inbound X-Request-Id here for the duration of one request's
+# work, every span opened inside the scope is stamped with it, and the
+# engine copies it onto the Request lifecycle (flight records included) —
+# so one id correlates server middleware, RAG retrieval, engine dispatches,
+# and the response the client got it echoed in. A ContextVar (not a plain
+# thread-local): the binding must survive explicit Context.run handoffs
+# while staying isolated between concurrently served requests.
+_request_id = contextvars.ContextVar('distllm-request-id', default=None)
+
+
+def current_request_id() -> str | None:
+    """The request id bound by the innermost :func:`request_scope`."""
+    return _request_id.get()
+
+
+@contextmanager
+def request_scope(request_id: str | None):
+    """Bind ``request_id`` as the current request for this context.
+
+    Spans opened inside the scope carry ``request_id`` in their
+    attributes, and ``LLMEngine.add_request`` stamps it onto the request's
+    lifecycle (``trace_id``). ``None`` is a no-op scope so call sites can
+    pass an optional id through unconditionally.
+    """
+    if request_id is None:
+        yield
+        return
+    token = _request_id.set(str(request_id))
+    try:
+        yield
+    finally:
+        _request_id.reset(token)
 
 
 def _stack() -> list['Span']:
@@ -51,6 +86,10 @@ class Span:
     error: str | None = None
     wall_time_s: float = 0.0
     attributes: dict[str, object] = field(default_factory=dict)
+    # Opening thread's ident: the Perfetto exporter keys a track per
+    # thread so concurrently open spans from different threads don't
+    # render as one impossibly overlapping stack.
+    thread_id: int = 0
 
     @property
     def duration_s(self) -> float:
@@ -69,6 +108,7 @@ class Span:
             'duration_s': self.duration_s if self.end_ns is not None else None,
             'status': self.status,
             'wall_time_s': self.wall_time_s,
+            'thread_id': self.thread_id,
         }
         if self.error is not None:
             record['error'] = self.error
@@ -153,7 +193,11 @@ def begin_span(name: str, *tags: str, **attributes: object) -> Span:
         start_ns=time.monotonic_ns(),
         wall_time_s=time.time(),
         attributes=dict(attributes),
+        thread_id=threading.get_ident(),
     )
+    rid = _request_id.get()
+    if rid is not None and 'request_id' not in record.attributes:
+        record.attributes['request_id'] = rid
     stack.append(record)
     return record
 

@@ -73,6 +73,10 @@ class TimeStats:
         return self._percentile(0.95)
 
     @property
+    def p99_s(self) -> float:
+        return self._percentile(0.99)
+
+    @property
     def max_s(self) -> float:
         return max(self.elapsed_s) if self.elapsed_s else 0.0
 

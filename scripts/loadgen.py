@@ -1,0 +1,112 @@
+"""Open-loop load-generator CLI (docs/observability.md).
+
+Builds a randomly initialized Mistral-family engine at the requested dims,
+warms it, replays a deterministic seeded Poisson workload through
+``distllm_tpu.generate.loadgen``, and prints one JSON report line:
+TTFT/TPOT/queue-wait p50/p95/p99, goodput, warm-prefix hits, and the
+per-window-kind MFU / bandwidth-utilization summary.
+
+Examples::
+
+    # CPU smoke (tiny dims, tens of requests)
+    JAX_PLATFORMS=cpu python scripts/loadgen.py --small --requests 24
+
+    # chip-scale open-loop run, 7B dims, 512 requests at 16 rps
+    python scripts/loadgen.py --requests 512 --rate 16 --slo 2.0
+
+The bench's checkpointed ``gen_load`` stage wraps the same machinery; this
+CLI exists for interactive what-if runs against one engine config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--requests', type=int, default=64)
+    parser.add_argument('--rate', type=float, default=8.0,
+                        help='Poisson arrival rate, requests/second')
+    parser.add_argument('--sessions', type=int, default=4)
+    parser.add_argument('--warm-fraction', type=float, default=0.5)
+    parser.add_argument('--prefix-tokens', type=int, default=32)
+    parser.add_argument('--slo', type=float, default=0.0,
+                        help='TTFT SLO seconds (0 = no goodput accounting)')
+    parser.add_argument('--small', action='store_true',
+                        help='tiny model dims (CPU smoke) instead of 7B')
+    parser.add_argument('--max-num-seqs', type=int, default=None)
+    parser.add_argument('--no-attribution', action='store_true')
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if os.environ.get('JAX_PLATFORMS'):
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, LLMEngine
+    from distllm_tpu.generate.loadgen import (
+        LoadgenConfig,
+        build_workload,
+        run_loadgen,
+    )
+    from distllm_tpu.models import mistral
+
+    if args.small:
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+        )
+        max_num_seqs, num_blocks, max_model_len = 4, 160, 256
+        decode_steps = 4
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+        max_num_seqs, num_blocks, max_model_len = 32, 712, 512
+        decode_steps = 16
+    if args.max_num_seqs:
+        max_num_seqs = args.max_num_seqs
+
+    engine_cfg = EngineConfig(
+        block_size=16,
+        num_blocks=num_blocks,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        decode_steps=decode_steps,
+        pipeline_depth=2,
+        sampling_top_window=64,
+        enable_prefix_cache=True,
+        ttft_slo_s=args.slo,
+        attribution=not args.no_attribution,
+    )
+
+    class _Tok:
+        eos_id = None
+
+    params = mistral.init_on_device(jax.random.PRNGKey(0), model_cfg)
+    engine = LLMEngine(model_cfg, params, _Tok(), engine_cfg, own_params=True)
+    engine.warmup()
+
+    workload = build_workload(LoadgenConfig(
+        seed=args.seed,
+        num_requests=args.requests,
+        rate_rps=args.rate,
+        num_sessions=args.sessions,
+        warm_fraction=args.warm_fraction,
+        prefix_tokens=args.prefix_tokens,
+        vocab_size=model_cfg.vocab_size,
+    ))
+    report = run_loadgen(engine, workload)
+    fragment = report.to_fragment('loadgen_')
+    fragment['loadgen_device'] = str(jax.devices()[0].device_kind)
+    print(json.dumps(fragment))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -217,9 +217,10 @@ def test_metric_names_registered_in_catalog():
     )
 
 
-def _flight_kind_catalog() -> set[str]:
-    """Flight-record kinds registered in ``instruments.FLIGHT_KINDS``
-    (AST-extracted, mirroring the metric-name catalog parser)."""
+def _frozenset_catalog(name: str) -> set[str]:
+    """String members of a ``NAME = frozenset({...})`` catalog in
+    ``instruments.py`` (AST-extracted, mirroring the metric-name catalog
+    parser)."""
     tree = ast.parse(
         (REPO / 'distllm_tpu' / 'observability' / 'instruments.py').read_text()
     )
@@ -227,7 +228,7 @@ def _flight_kind_catalog() -> set[str]:
         if not isinstance(node, ast.Assign):
             continue
         for tgt in node.targets:
-            if not (isinstance(tgt, ast.Name) and tgt.id == 'FLIGHT_KINDS'):
+            if not (isinstance(tgt, ast.Name) and tgt.id == name):
                 continue
             call = node.value  # frozenset({...})
             if isinstance(call, ast.Call) and call.args:
@@ -238,6 +239,10 @@ def _flight_kind_catalog() -> set[str]:
                     and isinstance(el.value, str)
                 }
     return set()
+
+
+def _flight_kind_catalog() -> set[str]:
+    return _frozenset_catalog('FLIGHT_KINDS')
 
 
 def test_flight_record_kinds_registered_in_catalog():
@@ -284,6 +289,52 @@ def test_flight_record_kinds_registered_in_catalog():
     assert not offenders, (
         'flight-record kinds not registered in instruments.FLIGHT_KINDS '
         '(add them there — the catalog is the flight-schema contract):\n'
+        + '\n'.join(sorted(set(offenders)))
+    )
+
+
+def test_trace_event_categories_registered_in_catalog():
+    """Every trace-event category the package emits (a string literal
+    passed as a ``cat=...`` keyword or a ``'cat': ...`` dict key) must be
+    registered in ``instruments.TRACE_EVENT_CATEGORIES``, mirroring the
+    metric-name and flight-kind rules: a category minted at a call site
+    would fragment the trace schema Perfetto queries, the exporter
+    validator, and downstream tooling filter on."""
+    registered = _frozenset_catalog('TRACE_EVENT_CATEGORIES')
+    assert registered, (
+        'TRACE_EVENT_CATEGORIES parse came back empty — rule is broken'
+    )
+    offenders = []
+    for path in sorted((REPO / 'distllm_tpu').rglob('*.py')):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        emitted: list[tuple[int, str]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (
+                        kw.arg == 'cat'
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                    ):
+                        emitted.append((node.lineno, kw.value.value))
+            elif isinstance(node, ast.Dict):
+                for key, value in zip(node.keys, node.values):
+                    if (
+                        isinstance(key, ast.Constant)
+                        and key.value == 'cat'
+                        and isinstance(value, ast.Constant)
+                        and isinstance(value.value, str)
+                    ):
+                        emitted.append((node.lineno, value.value))
+        for lineno, cat in emitted:
+            if cat not in registered:
+                offenders.append(
+                    f'{path.relative_to(REPO)}:{lineno} {cat}'
+                )
+    assert not offenders, (
+        'trace-event categories not registered in '
+        'instruments.TRACE_EVENT_CATEGORIES (add them there — the '
+        'catalog is the trace-schema contract):\n'
         + '\n'.join(sorted(set(offenders)))
     )
 

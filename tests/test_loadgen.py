@@ -1,0 +1,223 @@
+"""Open-loop load generator tests (ISSUE 10 tentpole + CI satellite):
+deterministic seeded workloads, the in-process run harness against a tiny
+real engine, and the ``gen_load`` bench stage as a CPU smoke (fast tier —
+tens of requests, seeded) asserting non-zero TTFT percentiles, a
+warm-prefix hit, and attribution-on/off token identity."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+
+from distllm_tpu.generate.engine import EngineConfig, LLMEngine
+from distllm_tpu.generate.loadgen import (
+    LoadgenConfig,
+    build_workload,
+    run_loadgen,
+)
+from distllm_tpu.models import mistral
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ----------------------------------------------------------- workload build
+def test_build_workload_deterministic():
+    cfg = LoadgenConfig(seed=7, num_requests=40)
+    a = build_workload(cfg)
+    b = build_workload(cfg)
+    assert a == b  # same seed -> byte-identical workload
+    c = build_workload(LoadgenConfig(seed=8, num_requests=40))
+    assert a != c
+
+
+def test_build_workload_poisson_arrivals_and_mix():
+    cfg = LoadgenConfig(
+        seed=0, num_requests=200, rate_rps=10.0, num_sessions=3,
+        warm_fraction=0.5, prefix_tokens=16,
+    )
+    workload = build_workload(cfg)
+    assert len(workload) == 200
+    ats = [a.at_s for a in workload]
+    assert ats == sorted(ats)
+    assert all(at > 0 for at in ats)
+    # Mean inter-arrival gap ~ 1/rate (Poisson process, generous bound).
+    mean_gap = ats[-1] / len(ats)
+    assert 0.05 < mean_gap < 0.2
+    warm = [a for a in workload if a.session is not None]
+    cold = [a for a in workload if a.session is None]
+    assert len(warm) > 50 and len(cold) > 50  # both sides of the mix
+    # Warm requests share their session's full prefix; sessions differ.
+    by_session: dict = {}
+    for a in warm:
+        by_session.setdefault(a.session, []).append(a)
+    assert len(by_session) == 3
+    for session, arrivals in by_session.items():
+        prefixes = {a.prompt_ids[: cfg.prefix_tokens] for a in arrivals}
+        assert len(prefixes) == 1
+    all_prefixes = {
+        arrivals[0].prompt_ids[: cfg.prefix_tokens]
+        for arrivals in by_session.values()
+    }
+    assert len(all_prefixes) == 3
+    # Output budgets stay in range.
+    lo, hi = cfg.output_tokens
+    assert all(lo <= a.max_tokens <= hi for a in workload)
+
+
+def test_build_workload_rejects_bad_config():
+    import pytest
+
+    with pytest.raises(ValueError):
+        build_workload(LoadgenConfig(num_requests=0))
+    with pytest.raises(ValueError):
+        build_workload(LoadgenConfig(rate_rps=0.0))
+
+
+# --------------------------------------------------------- run harness
+def test_run_loadgen_tiny_engine_reports():
+    cfg = mistral.MistralConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64, dtype='float32',
+    )
+    params = mistral.init(jax.random.PRNGKey(0), cfg)
+
+    class IdTokenizer:
+        eos_id = None
+
+    engine = LLMEngine(
+        cfg, params, IdTokenizer(),
+        EngineConfig(
+            block_size=4, num_blocks=64, max_num_seqs=4, max_model_len=64,
+            prefer_native_allocator=False, enable_prefix_cache=True,
+            ttft_slo_s=30.0, decode_steps=4,
+        ),
+    )
+    load_cfg = LoadgenConfig(
+        seed=3, num_requests=10, rate_rps=200.0, num_sessions=2,
+        warm_fraction=0.6, prefix_tokens=8, prompt_tokens=(3, 10),
+        output_tokens=(2, 6), vocab_size=cfg.vocab_size,
+    )
+    workload = build_workload(load_cfg)
+    report = run_loadgen(engine, workload)
+    assert report.requests == 10
+    assert report.tokens > 0
+    assert len(report.tokens_by_request) == 10
+    for arrival, tokens in zip(
+        sorted(workload, key=lambda a: a.at_s), report.tokens_by_request
+    ):
+        assert 0 < len(tokens) <= arrival.max_tokens
+    # Histogram-estimated percentiles exist and are positive and ordered.
+    p50 = report.percentiles['ttft_p50']
+    p95 = report.percentiles['ttft_p95']
+    p99 = report.percentiles['ttft_p99']
+    assert p50 and p50 > 0
+    assert p95 and p50 <= p95 <= p99
+    assert report.percentiles['queue_wait_p50'] is not None
+    # Warm sessions actually hit the prefix cache (2-block prefixes).
+    assert report.warm_prefix_hit_tokens > 0
+    assert report.warm_requests + report.cold_requests == 10
+    # SLO accounting: a 30 s SLO on a tiny engine is always met.
+    assert report.slo_met == 10 and report.slo_missed == 0
+    assert report.goodput_tokens == report.tokens
+    # Roofline attribution ran per window kind.
+    assert 'decode' in report.roofline and 'prefill' in report.roofline
+    assert report.roofline['decode']['mfu'] > 0
+    assert report.roofline['decode']['bw_util'] > 0
+    # Flight records carry the attribution split on this run's windows.
+    decode_records = [
+        r for r in engine.flight.snapshot()
+        if r['kind'] == 'decode' and 'fetch_s' in r
+    ]
+    assert decode_records
+    assert all('dispatch_s' in r and 'mfu' in r for r in decode_records)
+    # And the fragment flattening used by the bench stage is total —
+    # and strict-JSON clean (no inf/nan leaks into the bench record).
+    fragment = report.to_fragment('x_')
+    assert fragment['x_requests'] == 10
+    assert fragment['x_ttft_p50'] == round(p50, 6)
+    assert 'x_mfu_decode' in fragment and 'x_bw_util_decode' in fragment
+    json.loads(json.dumps(fragment, allow_nan=False))
+
+    # Attribution-off replay on the SAME warm engine: bit-identical
+    # greedy tokens, and the roofline summary is delta-scoped — nothing
+    # accumulates while attribution is off, so the off arm reports {}
+    # instead of the on arm's stale aggregate.
+    engine.attribution = False
+    off = run_loadgen(engine, workload)
+    assert off.tokens_by_request == report.tokens_by_request
+    assert off.roofline == {}
+    # Flipping attribution ON at runtime works even though this engine
+    # could have been built with attribution off (cost model is always
+    # constructed): the next run accumulates again.
+    engine.attribution = True
+    back_on = run_loadgen(engine, workload)
+    assert back_on.tokens_by_request == report.tokens_by_request
+    assert 'decode' in back_on.roofline
+
+
+def test_run_loadgen_single_request_offered_rps_is_json_safe():
+    from distllm_tpu.generate.loadgen import LoadReport
+
+    report = LoadReport(
+        requests=1, tokens=4, elapsed_s=0.1, offered_rps=None,
+        achieved_tok_s=40.0, percentiles={}, window_tok_s={},
+        goodput_tokens=4, goodput_frac=1.0, slo_met=1, slo_missed=0,
+        warm_prefix_hit_tokens=0, warm_requests=0, cold_requests=1,
+        roofline={}, tokens_by_request=[[1, 2, 3, 4]],
+    )
+    fragment = report.to_fragment('x_')
+    assert fragment['x_offered_rps'] is None
+    json.loads(json.dumps(fragment, allow_nan=False))
+
+
+# -------------------------------------------- gen_load bench stage (smoke)
+def _run_stage(tmp_path, **env_extra):
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS='cpu',
+        DISTLLM_BENCH_SMALL='1',
+        DISTLLM_BENCH_RECORD_DIR=str(tmp_path),
+        DISTLLM_BENCH_BUNDLE_DIR=str(tmp_path / 'bundles'),
+        DISTLLM_BENCH_WATCHDOG_S='0',
+    )
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / 'bench.py'), '--stage', 'gen_load'],
+        capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_gen_load_stage_cpu_smoke(tmp_path):
+    """The CI satellite: the checkpointed gen_load fragment reports
+    non-zero TTFT percentiles, at least one warm-prefix hit, per-kind
+    MFU/bandwidth utilization, and attribution-on/off token identity."""
+    fragment = _run_stage(tmp_path)
+    assert fragment['gen_load_requests'] == 24
+    assert fragment['gen_load_ttft_p50'] > 0
+    assert fragment['gen_load_ttft_p95'] > 0
+    assert fragment['gen_load_ttft_p99'] >= fragment['gen_load_ttft_p95']
+    assert fragment['gen_load_tpot_p50'] > 0
+    assert fragment['gen_load_queue_wait_p50'] is not None
+    assert fragment['gen_load_warm_prefix_hit_tokens'] >= 1
+    assert fragment['gen_load_tokens_identical'] is True
+    assert 'gen_load_error' not in fragment
+    # Goodput: SLO accounting plus per-request delivered-rate percentiles.
+    assert fragment['gen_load_goodput_tokens'] > 0
+    assert fragment['gen_load_goodput_tok_s_p50'] > 0
+    assert fragment['gen_load_slo_met'] + fragment['gen_load_slo_missed'] == 24
+    # Per-window-kind roofline attribution in the checkpointed fragment.
+    assert fragment['gen_load_mfu_decode'] > 0
+    assert fragment['gen_load_bw_util_decode'] > 0
+    assert fragment['gen_load_mfu_prefill'] > 0
+
+
+def test_gen_load_stage_env_skip(tmp_path):
+    fragment = _run_stage(tmp_path, DISTLLM_BENCH_LOAD='0')
+    assert fragment == {'gen_load_skipped': 'DISTLLM_BENCH_LOAD=0'}

@@ -113,9 +113,19 @@ def test_two_process_sharded_forward_matches_single(tmp_path):
                 out,
             )
         )
-    for proc, _ in procs:
-        stdout, _ = proc.communicate(timeout=180)
-        assert proc.returncode == 0, stdout[-2000:]
+    try:
+        for proc, _ in procs:
+            stdout, _ = proc.communicate(timeout=180)
+            assert proc.returncode == 0, stdout[-2000:]
+    finally:
+        # A timeout/assert must not LEAK the other rank: an orphaned
+        # Gloo-barrier process spins at 100% CPU forever and starves
+        # every test after this one (measured: the tier-1 run burned its
+        # whole remaining budget here on a 1-core box).
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
 
     # Single-process reference on this process's CPU backend.
     import jax
@@ -163,7 +173,27 @@ def test_pod_worker_joins_jax_runtime(tmp_path):
     )
     try:
         executor = ZmqPoolExecutor(coordinator)
-        results = executor.map(_report_runtime, [0])
+        # map() blocks forever if the worker dies before joining (e.g. a
+        # jax.distributed incompatibility) — bound it so a wedged worker
+        # costs one failed test, not the whole remaining tier-1 budget
+        # (measured on a 1-core box: this line ate every test after it).
+        import threading
+
+        result_box: dict = {}
+        mapper = threading.Thread(
+            target=lambda: result_box.update(
+                r=executor.map(_report_runtime, [0])
+            ),
+            daemon=True,
+        )
+        mapper.start()
+        mapper.join(timeout=150)
+        assert 'r' in result_box, (
+            'worker never completed the task (map wedged); worker log:\n'
+            + (proc.stdout.read()[-2000:] if proc.poll() is not None else
+               '<worker still running>')
+        )
+        results = result_box['r']
         assert results == [(0, 1)]
         # Graceful teardown MUST work without signals: a worker in the
         # global JAX runtime swallows SIGTERM (preemption notifier), so

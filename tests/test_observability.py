@@ -94,6 +94,64 @@ def test_histogram_semantics():
         registry.histogram('test_bad', buckets=(1.0, 1.0))
 
 
+def test_histogram_quantile_known_distribution():
+    """Pin the linear-interpolation estimator on a known distribution:
+    100 observations spread uniformly inside (0, 10] against buckets
+    (1, 2, ..., 10) — every quantile is exact for uniform-in-bucket
+    data, which is precisely the estimator's model."""
+    registry = MetricsRegistry()
+    h = registry.histogram(
+        'test_quantile_seconds', buckets=tuple(float(b) for b in range(1, 11))
+    )
+    for i in range(100):
+        h.observe((i + 0.5) / 10.0)  # 10 observations per bucket
+    assert h.quantile(0.5) == pytest.approx(5.0)
+    assert h.quantile(0.95) == pytest.approx(9.5)
+    assert h.quantile(0.99) == pytest.approx(9.9)
+    assert h.quantile(0.0) == pytest.approx(0.0)
+    assert h.quantile(1.0) == pytest.approx(10.0)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_quantile_edge_cases():
+    registry = MetricsRegistry()
+    h = registry.histogram('test_q_edge_seconds', buckets=(1.0, 10.0))
+    assert h.quantile(0.5) is None  # empty histogram has no quantiles
+    h.observe(0.5)
+    # Single observation in the first bucket interpolates from 0.
+    assert 0 < h.quantile(0.5) <= 1.0
+    h.observe(100.0)  # +Inf bucket
+    # Ranks landing in +Inf clamp to the highest finite edge.
+    assert h.quantile(0.99) == pytest.approx(10.0)
+    # Labeled children expose the same estimator.
+    labeled = registry.histogram(
+        'test_q_labeled_seconds', labelnames=('kind',), buckets=(1.0, 2.0)
+    )
+    labeled.labels(kind='a').observe(1.5)
+    assert 1.0 <= labeled.labels(kind='a').quantile(0.5) <= 2.0
+
+
+def test_quantile_from_cumulative_delta_isolates_window():
+    """The loadgen pattern: difference two cumulative_counts() snapshots
+    to get quantiles over only the observations in between."""
+    from distllm_tpu.observability import quantile_from_cumulative
+
+    registry = MetricsRegistry()
+    h = registry.histogram('test_q_delta_seconds', buckets=(1.0, 2.0, 4.0))
+    h.observe(0.5)  # pre-window noise (a warmup request)
+    before = h.cumulative_counts()
+    for _ in range(10):
+        h.observe(3.0)  # the measured window: all in bucket (2, 4]
+    delta = [a - b for a, b in zip(h.cumulative_counts(), before)]
+    assert sum(
+        n for n in delta
+    ) == 10 or delta[-1] == 10  # cumulative: final entry counts all
+    p50 = quantile_from_cumulative(h.buckets, delta, 0.5)
+    assert 2.0 < p50 <= 4.0  # the warmup 0.5 s observation is excluded
+    assert quantile_from_cumulative(h.buckets, [0, 0, 0, 0], 0.5) is None
+
+
 def test_log_buckets_ladder():
     buckets = log_buckets(1e-3, 10.0, per_decade=1)
     assert buckets == (0.001, 0.01, 0.1, 1.0, 10.0)
@@ -162,6 +220,39 @@ def test_trace_ring_eviction_and_dump(tmp_path):
     assert [r['name'] for r in records] == ['s2', 's3', 's4']
     assert all(r['status'] == 'ok' for r in records)
     assert all(r['duration_s'] is not None for r in records)
+
+
+def test_request_scope_stamps_spans_and_nests():
+    from distllm_tpu.observability import (
+        current_request_id,
+        request_scope,
+    )
+
+    buffer = TraceBuffer()
+    assert current_request_id() is None
+    with request_scope('req-42'):
+        assert current_request_id() == 'req-42'
+        with span('scoped-work', buffer=buffer) as s:
+            assert s.attributes['request_id'] == 'req-42'
+        with request_scope('req-inner'):
+            assert current_request_id() == 'req-inner'
+        assert current_request_id() == 'req-42'
+    assert current_request_id() is None
+    # None scope is a no-op (optional ids pass through unconditionally).
+    with request_scope(None):
+        assert current_request_id() is None
+        with span('unscoped-work', buffer=buffer) as s:
+            assert 'request_id' not in s.attributes
+    # An explicit attribute wins over the scope.
+    with request_scope('req-outer'):
+        with span('explicit', buffer=buffer, request_id='req-pinned') as s:
+            assert s.attributes['request_id'] == 'req-pinned'
+    # Spans record their opening thread (the Perfetto track key).
+    import threading
+
+    recorded = buffer.snapshot()[-1]
+    assert recorded.thread_id == threading.get_ident()
+    assert recorded.to_dict()['thread_id'] == recorded.thread_id
 
 
 # --------------------------------------------------------------- Timer shim
@@ -307,6 +398,58 @@ def test_aggregate_dedups_same_measurement_across_formats(tmp_path, capsys):
 
     merged = aggregate_logs([timer_log, span_dump])
     assert merged[('dedup-stage', 'f7')].count == 1
+
+
+def test_aggregate_table_reports_cross_host_percentiles(tmp_path):
+    """The table carries p50/p95/p99 computed over the MERGED multi-host
+    distribution, not per-file."""
+    log_a = tmp_path / 'a.log'
+    log_b = tmp_path / 'b.log'
+    log_a.write_text(_fake_log('embed', [1.0] * 50))
+    log_b.write_text(_fake_log('embed', [2.0] * 49 + [10.0]))
+    merged = aggregate_logs([log_a, log_b])
+    stats = merged[('embed',)]
+    assert stats.count == 100
+    assert stats.p50_s == pytest.approx(1.0)
+    assert stats.p99_s == pytest.approx(2.0)
+    assert stats.max_s == pytest.approx(10.0)
+    table = format_stats_table(merged)
+    header = table.splitlines()[0]
+    assert 'p50_s' in header and 'p95_s' in header and 'p99_s' in header
+
+
+def test_aggregate_cli_writes_combined_perfetto(tmp_path, capsys):
+    """--perfetto merges flight/span JSONL dumps from multiple hosts into
+    one valid trace with a process group per input file."""
+    import json as _json
+
+    from distllm_tpu.observability import validate_trace_events
+    from distllm_tpu.observability.aggregate import main
+
+    flight = FlightRecorder()
+    flight.record('decode', duration_s=0.25, batch=2, tokens=32)
+    flight.record(
+        'request', e2e_s=0.5, ttft_s=0.1, request_id=0, output_tokens=8
+    )
+    flight_dump = tmp_path / 'host-a-flight.jsonl'
+    flight.dump_jsonl(flight_dump)
+    buffer = TraceBuffer()
+    with span('host-b-work', buffer=buffer):
+        pass
+    span_dump = tmp_path / 'host-b-traces.jsonl'
+    buffer.dump_jsonl(span_dump)
+    out = tmp_path / 'combined.json'
+    assert main(
+        [str(flight_dump), str(span_dump), '--perfetto', str(out)]
+    ) == 0
+    captured = capsys.readouterr().out
+    assert 'combined.json' in captured
+    doc = _json.loads(out.read_text())
+    assert validate_trace_events(doc) == []
+    pids = {e['pid'] for e in doc['traceEvents']}
+    assert pids == {1, 2}
+    names = {e['name'] for e in doc['traceEvents'] if e.get('ph') != 'M'}
+    assert 'decode' in names and 'host-b-work' in names
 
 
 def test_aggregate_cli_entry_point(tmp_path, capsys):

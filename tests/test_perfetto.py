@@ -1,0 +1,191 @@
+"""Perfetto/Chrome trace-event exporter tests (ISSUE 10 tentpole):
+deterministic flight + span fixtures rendered to a structurally valid
+trace (monotonic ts per track, complete X slices, registered categories),
+round-tripped through ``dump_debug_bundle`` and the multi-host merge."""
+
+from __future__ import annotations
+
+import json
+
+from distllm_tpu.observability import (
+    FlightRecorder,
+    dump_debug_bundle,
+    merge_host_traces,
+    to_trace_events,
+    validate_trace_events,
+)
+from distllm_tpu.observability.instruments import TRACE_EVENT_CATEGORIES
+from distllm_tpu.observability.perfetto import trace_time_origin
+
+
+def _fixture_records() -> list[dict]:
+    """A deterministic serving episode: prefill → two decode windows with
+    a host gap between them → a preemption event → two finished requests
+    (one carrying a propagated trace id)."""
+    return [
+        {'kind': 'prefill', 't_wall': 100.20, 'duration_s': 0.20,
+         'batch': 2, 'tokens': 64, 'rids': [0, 1],
+         'host_s': 0.01, 'put_s': 0.02, 'dispatch_s': 0.17},
+        {'kind': 'decode', 't_wall': 100.50, 'duration_s': 0.25,
+         'batch': 2, 'tokens': 32, 'mfu': 0.4, 'bw_util': 0.8},
+        # 0.20 s host gap between this window's start (100.70) and the
+        # previous window's end (100.50).
+        {'kind': 'decode', 't_wall': 100.95, 'duration_s': 0.25,
+         'batch': 2, 'tokens': 32},
+        {'kind': 'preempt', 't_wall': 100.97, 'request_id': 1},
+        {'kind': 'request', 't_wall': 100.98, 'request_id': 0,
+         'trace_id': 'req-fixture', 'e2e_s': 0.9, 'ttft_s': 0.35,
+         'queue_wait_s': 0.05, 'output_tokens': 17, 'prompt_tokens': 30},
+        {'kind': 'request', 't_wall': 100.99, 'request_id': 1,
+         'trace_id': None, 'e2e_s': 0.8, 'ttft_s': 0.4,
+         'queue_wait_s': 0.1, 'output_tokens': 11, 'prompt_tokens': 34},
+    ]
+
+
+def _fixture_spans() -> list[dict]:
+    return [
+        {'name': 'chat-generate', 'wall_time_s': 100.05, 'duration_s': 0.95,
+         'status': 'ok', 'span_id': 1, 'thread_id': 7,
+         'attributes': {'request_id': 'req-fixture'}},
+        {'name': 'chat-retrieve', 'wall_time_s': 100.01, 'duration_s': 0.03,
+         'status': 'ok', 'span_id': 2, 'thread_id': 7, 'attributes': {}},
+    ]
+
+
+def _events(doc, **match):
+    return [
+        e for e in doc['traceEvents']
+        if all(e.get(k) == v for k, v in match.items())
+    ]
+
+
+def test_exporter_structural_invariants():
+    doc = to_trace_events(_fixture_records(), _fixture_spans())
+    assert validate_trace_events(doc) == []
+    # JSON round trip survives (what GET /debug/perfetto serves).
+    reparsed = json.loads(json.dumps(doc))
+    assert validate_trace_events(reparsed) == []
+    assert reparsed['displayTimeUnit'] == 'ms'
+    # ts is monotonic per (pid, tid) track — asserted independently of
+    # the validator so a validator bug cannot mask a sort regression.
+    per_track: dict = {}
+    for event in reparsed['traceEvents']:
+        if event['ph'] == 'M':
+            continue
+        per_track.setdefault((event['pid'], event.get('tid')), []).append(
+            event['ts']
+        )
+    for track, stamps in per_track.items():
+        assert stamps == sorted(stamps), track
+    # Only X / i / M phases are emitted (complete slices, never torn B/E).
+    assert {e['ph'] for e in reparsed['traceEvents']} <= {'X', 'i', 'M'}
+    # Every non-metadata category is registered in the catalog.
+    cats = {e['cat'] for e in reparsed['traceEvents'] if e['ph'] != 'M'}
+    assert cats <= TRACE_EVENT_CATEGORIES
+
+
+def test_exporter_tracks_and_host_gap():
+    doc = to_trace_events(_fixture_records(), _fixture_spans())
+    # One track per window kind actually present.
+    prefill = _events(doc, cat='engine_step', name='prefill')
+    decode = _events(doc, cat='engine_step', name='decode')
+    assert len(prefill) == 1 and len(decode) == 2
+    assert prefill[0]['tid'] != decode[0]['tid']
+    assert decode[0]['tid'] == decode[1]['tid']
+    # Flight fields survive as args (the attribution split included).
+    assert prefill[0]['args']['host_s'] == 0.01
+    assert decode[0]['args']['mfu'] == 0.4
+    # Exactly the fixture's two idle gaps: prefill end (100.20) -> first
+    # decode start (100.25), and first decode end (100.50) -> second
+    # decode start (100.70).
+    gaps = sorted(e['dur'] for e in _events(doc, cat='host_gap'))
+    assert len(gaps) == 2
+    assert abs(gaps[0] - 0.05e6) < 1.0 and abs(gaps[1] - 0.20e6) < 1.0
+    # Preemption renders as an instant.
+    assert _events(doc, cat='engine_event', name='preempt')[0]['ph'] == 'i'
+
+
+def test_exporter_request_correlation():
+    """The tentpole acceptance shape: a request-id-correlated track that
+    spans server (span) -> engine (lifecycle slice + nested ttft)."""
+    doc = to_trace_events(_fixture_records(), _fixture_spans())
+    lifecycle = _events(doc, cat='request', name='req-fixture')
+    assert len(lifecycle) == 1
+    tid = lifecycle[0]['tid']
+    # The server span carrying the same request id lands on that track.
+    server_span = _events(doc, cat='span', name='chat-generate')
+    assert server_span[0]['tid'] == tid
+    # Nested ttft/queue_wait slices share the track and fit inside.
+    ttft = [e for e in _events(doc, cat='request', name='ttft')
+            if e['tid'] == tid]
+    assert len(ttft) == 1
+    assert ttft[0]['ts'] == lifecycle[0]['ts']
+    assert ttft[0]['dur'] <= lifecycle[0]['dur']
+    # The un-propagated request still gets a track, keyed by engine rid.
+    assert _events(doc, cat='request', name='rid-1')
+    # The request-less span goes to a per-thread track, not a request's.
+    retrieve = _events(doc, cat='span', name='chat-retrieve')
+    assert retrieve[0]['tid'] != tid
+
+
+def test_exporter_skips_torn_and_unknown_records():
+    records = _fixture_records() + [
+        {'kind': 'mystery-kind', 't_wall': 101.0, 'duration_s': 0.1},
+        {'kind': 'decode'},  # no t_wall (torn line)
+        {'no_kind': True},
+        {'kind': 'request', 't_wall': 101.0},  # pre-attribution: no e2e_s
+    ]
+    spans = _fixture_spans() + [{'name': 'open-span', 'wall_time_s': 100.0}]
+    doc = to_trace_events(records, spans)
+    assert validate_trace_events(doc) == []
+    assert not _events(doc, name='mystery-kind')
+    assert not _events(doc, name='open-span')
+
+
+def test_debug_bundle_round_trip(tmp_path):
+    """The dump_debug_bundle satellite: a real recorder's ring lands in
+    the bundle as perfetto.json, parses, and validates."""
+    recorder = FlightRecorder()
+    for record in _fixture_records():
+        fields = dict(record)
+        recorder.record(fields.pop('kind'), **{
+            k: v for k, v in fields.items() if k != 't_wall'
+        })
+    paths = dump_debug_bundle(
+        tmp_path / 'bundle', reason='perfetto test', recorder=recorder
+    )
+    assert 'perfetto' in paths
+    doc = json.loads((tmp_path / 'bundle' / 'perfetto.json').read_text())
+    assert validate_trace_events(doc) == []
+    names = {e['name'] for e in doc['traceEvents']}
+    assert {'prefill', 'decode'} <= names
+
+
+def test_merge_host_traces_per_host_groups():
+    host_a = _fixture_records()
+    host_b = [
+        {'kind': 'decode', 't_wall': 100.40, 'duration_s': 0.3,
+         'batch': 4, 'tokens': 64},
+        {'kind': 'request', 't_wall': 100.70, 'request_id': 0,
+         'e2e_s': 0.5, 'ttft_s': 0.2, 'output_tokens': 9},
+    ]
+    doc = merge_host_traces([
+        ('host-a', host_a, _fixture_spans()),
+        ('host-b', host_b, []),
+    ])
+    assert validate_trace_events(doc) == []
+    pids = {e['pid'] for e in doc['traceEvents']}
+    assert pids == {1, 2}
+    process_names = {
+        e['args']['name'] for e in doc['traceEvents']
+        if e['ph'] == 'M' and e['name'] == 'process_name'
+    }
+    assert process_names == {'host-a', 'host-b'}
+    # Shared time origin: host-b's decode starts 0.05 s after host-a's
+    # earliest span (100.05), not at zero.
+    b_decode = [
+        e for e in doc['traceEvents']
+        if e['pid'] == 2 and e.get('cat') == 'engine_step'
+    ]
+    origin = trace_time_origin(host_a, _fixture_spans())
+    assert abs(b_decode[0]['ts'] - (100.40 - 0.3 - origin) * 1e6) < 1.0
