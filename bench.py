@@ -425,10 +425,19 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
     # DISTLLM_BENCH_PROFILE=<dir> wraps the timed region in a profiler
     # trace (XPlane + TensorBoard format): on hardware this shows per-op
     # device time for the decode windows — the ground truth the AOT HLO
-    # census (scripts/probe_decode_hlo.py) can only approximate.
+    # census (scripts/probe_decode_hlo.py) can only approximate. Routed
+    # through the bounded capture helper (observability/profiling.py):
+    # an unsupported-backend profiler error downgrades to a fragment
+    # field instead of killing the stage, and a hung region cannot leave
+    # the trace growing forever.
     profile_dir = os.environ.get('DISTLLM_BENCH_PROFILE')
+    capture = None
     if profile_dir:
-        jax.profiler.start_trace(profile_dir)
+        from distllm_tpu.observability.profiling import get_profiler_capture
+
+        capture = get_profiler_capture()
+        if not capture.start(profile_dir, max_seconds=1800.0):
+            capture = None
     try:
         start = time.perf_counter()
         outs = engine.generate_ids(prompts, sampling)
@@ -436,8 +445,8 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
     finally:
         # Flush even when generation dies mid-decode — a partial trace of
         # the failing run is exactly what the profile exists to capture.
-        if profile_dir:
-            jax.profiler.stop_trace()
+        if capture is not None:
+            capture.stop()
     n_tokens = sum(len(o) for o in outs)
     throughput = n_tokens / elapsed
 
@@ -488,6 +497,14 @@ def _run_gen(quantization: str | None, prefix: str) -> dict:
         out[f'{prefix}quantization'] = quantization
     if fallback_reason:
         out[f'{prefix}attn_fallback_reason'] = fallback_reason
+    if profile_dir and capture is None:
+        # The profiler was requested but could not start (unsupported
+        # backend, busy slot): the stage ran unprofiled and says so.
+        from distllm_tpu.observability.profiling import get_profiler_capture
+
+        out[f'{prefix}profile_error'] = (
+            get_profiler_capture().state().get('last_error')
+        )
     for key, val in engine.telemetry.items():
         out[f'{prefix}{key}'] = val
     return out
@@ -1358,6 +1375,7 @@ def _run_stage_entry(stage: str) -> None:
         StallWatchdog,
         dump_debug_bundle,
     )
+    from distllm_tpu.observability.startup import record_backend_init
 
     # Smoke-test hook (tests/test_smoke_bench_contract.py): park this stage
     # before any heavy import so the orchestrator's kill paths can be
@@ -1374,6 +1392,17 @@ def _run_stage_entry(stage: str) -> None:
             print(f'[bench-bundle] {bundle_dir}', file=sys.stderr, flush=True)
         except Exception:
             pass
+
+    # Attribute this stage subprocess's REAL backend init: by the time an
+    # engine exists the PJRT client is already up (params load first), so
+    # the engine-side record measures ~0 — here is where r03/r04's wedged
+    # init actually happened. A dead backend raises AFTER the phase
+    # records the error, so the bundle carries it.
+    try:
+        record_backend_init()
+    except Exception as exc:
+        _dump(f'{stage}: backend init failed: {exc!r}'[:300])
+        raise
 
     def _on_sigterm(signum, frame):  # budget kill from the orchestrator
         _dump(f'{stage}: SIGTERM (stage budget expired)')

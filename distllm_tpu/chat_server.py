@@ -27,7 +27,10 @@ Observability surface (docs/observability.md):
   Perfetto/``chrome://tracing`` trace-event JSON (open it at
   https://ui.perfetto.dev), request-id-correlated tracks included;
 - ``GET /debug/bundle`` — dump a full debug bundle (flight ring + metrics
-  + traces + perfetto.json) to disk and return the written paths.
+  + traces + perfetto.json + startup.json) to disk and return the written
+  paths;
+- ``GET /debug/xprof?seconds=N`` — bounded on-demand ``jax.profiler``
+  capture to disk (one at a time; errors reported, never fatal).
 
 Request-scoped tracing: every ``POST /v1/chat/completions`` accepts an
 ``X-Request-Id`` header (one is generated when absent), binds it around
@@ -50,6 +53,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import math
 import os
 import re
 import time
@@ -61,6 +65,7 @@ from distllm_tpu.observability import (
     StallWatchdog,
     dump_debug_bundle,
     get_flight_recorder,
+    get_profiler_capture,
     get_trace_buffer,
     instruments,
     render_prometheus,
@@ -319,6 +324,40 @@ def build_app(config: ChatAppConfig):
         )
         return web.json_response({'bundle_dir': directory, 'paths': paths})
 
+    async def xprof(request: 'web.Request') -> 'web.Response':
+        """On-demand bounded profiler capture (observability/profiling.py):
+        ``GET /debug/xprof?seconds=N`` blocks for N seconds of
+        ``jax.profiler`` capture and returns the trace directory (XPlane +
+        TensorBoard format). One capture at a time — a concurrent request
+        gets 409; an unsupported backend gets 501, never a dead server."""
+        try:
+            seconds = float(request.query.get('seconds', '2'))
+        except ValueError:
+            seconds = math.nan
+        # NaN passes float() and slides through min/max clamps unchanged.
+        if not math.isfinite(seconds):
+            return web.json_response(
+                {'error': {'message': 'seconds must be a finite number'}},
+                status=400,
+            )
+        seconds = min(max(seconds, 0.1), 60.0)
+        directory = _debug_dir('xprof')
+        capture = get_profiler_capture()
+        # Default thread pool (like bundle/perfetto): the capture sleep
+        # must not freeze the event loop or queue behind a wedged
+        # generate — a wedge is exactly when an operator wants a profile.
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(
+            None, lambda: capture.capture(directory, seconds)
+        )
+        status = (
+            200 if result['ok'] else 409 if result['rejected'] else 501
+        )
+        return web.json_response(
+            {**result, 'seconds': seconds, 'state': capture.state()},
+            status=status,
+        )
+
     async def preflight(request: 'web.Request') -> 'web.Response':
         return web.Response(status=204)
 
@@ -356,6 +395,7 @@ def build_app(config: ChatAppConfig):
     app.router.add_get('/debug/flight', flight)
     app.router.add_get('/debug/perfetto', perfetto)
     app.router.add_get('/debug/bundle', bundle)
+    app.router.add_get('/debug/xprof', xprof)
     # Browser preflight for any path (CORS headers added by the middleware).
     app.router.add_route('OPTIONS', '/{tail:.*}', preflight)
     return app
@@ -372,6 +412,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument('--host', default='0.0.0.0')
     parser.add_argument('--port', type=int, default=8000)
     args = parser.parse_args(argv)
+
+    # Attribute the REAL backend init here, before the session/engine
+    # build touches the device through weight loading — a wedged PJRT
+    # client init is otherwise invisible (the r03/r04 failure mode).
+    from distllm_tpu.observability import record_backend_init
+
+    record_backend_init()
 
     config_path = args.config or os.environ.get('DISTLLM_CHAT_CONFIG')
     config = (

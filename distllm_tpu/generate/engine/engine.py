@@ -53,7 +53,12 @@ from distllm_tpu.generate.engine.scheduler import (
 from distllm_tpu.models import mistral
 from distllm_tpu.models.tokenizer import bucket_ladder, pick_bucket
 from distllm_tpu.observability import instruments as _metrics
+from distllm_tpu.observability import xla_cost as _xla_cost
 from distllm_tpu.observability.flight import get_flight_recorder
+from distllm_tpu.observability.startup import (
+    get_compile_watcher,
+    record_backend_init,
+)
 from distllm_tpu.ops.sampling import sample_tokens
 from distllm_tpu.utils import BaseConfig
 
@@ -390,6 +395,17 @@ class LLMEngine:
         self.config = config or EngineConfig()
         self._own_params = own_params
         cfg = self.config
+        # Startup attribution (docs/observability.md): every expensive
+        # init/warmup phase below lands as a 'compile' flight record, so
+        # a wedged startup — the r03/r04 bench failure mode — names the
+        # phase it died in. The first engine in a process also pays (and
+        # attributes) the real backend init here; later calls are
+        # near-instant cache-hit records.
+        self._compile_watcher = get_compile_watcher()
+        # Per-engine dedup scope: a rebuilt engine's jit wrappers really
+        # recompile, so its phases must start cold in the watcher.
+        self._compile_scope = self._compile_watcher.new_scope()
+        record_backend_init(self._compile_watcher)
 
         # Tensor parallelism: K/V pages shard over the kv-head dim on the
         # mesh's model axis (same split as the attention heads in
@@ -471,12 +487,16 @@ class LLMEngine:
             # buffers: each replaced bf16 leaf is freed BEFORE its codes are
             # materialized, so HBM peaks at the unquantized weights instead
             # of weights+codes (which OOMed a 16 GiB v5e at 7B dims).
-            self.params = quantize_pytree(
-                self.params,
-                mode=cfg.quantization,
-                out_dtype=model.dtype,
-                delete_source=self._own_params,
-            )
+            with self._compile_watcher.phase(
+                'quantize', cfg.quantization, compiles=False,
+                scope=self._compile_scope,
+            ):
+                self.params = quantize_pytree(
+                    self.params,
+                    mode=cfg.quantization,
+                    out_dtype=model.dtype,
+                    delete_source=self._own_params,
+                )
             # Resolve the quantized-matmul tier ONCE, here, and pin it
             # into the model config the jitted forwards close over.
             # dense() otherwise re-reads the process-global
@@ -644,18 +664,30 @@ class LLMEngine:
             # so the migrated layout serves every executable.
             compiled = formats = None
             try:
-                compiled, formats = self._compile_auto_layout(window_fn)
+                with self._compile_watcher.phase(
+                    'auto_layout', f'b{cfg.max_num_seqs}',
+                    scope=self._compile_scope,
+                ):
+                    compiled, formats = self._compile_auto_layout(window_fn)
             except Exception as exc:  # pragma: no cover - TPU-only path
                 self.telemetry['auto_layout_fallback'] = repr(exc)[:300]
             if compiled is not None:
                 # Destructive from here on (source leaves are deleted as
                 # they migrate); failures are fatal, not a fallback —
                 # callers rebuild with fresh params (see bench.py ladder).
-                self.params = self._migrate_params(formats)
+                with self._compile_watcher.phase(
+                    'migrate_params', 'params', compiles=False,
+                    scope=self._compile_scope,
+                ):
+                    self.params = self._migrate_params(formats)
                 self._decode_window = compiled
                 self._pin_mixed_layout(formats)
                 self._pin_spec_layout(formats)
-        self.kv.allocate()
+        with self._compile_watcher.phase(
+            'kv_allocate', f'blocks{cfg.num_blocks}', compiles=False,
+            scope=self._compile_scope,
+        ):
+            self.kv.allocate()
         # Merge host-known overrides (fresh admissions) into the device-
         # carried last-token vector between pipelined windows.
         self._merge_ids = jax.jit(
@@ -694,6 +726,11 @@ class LLMEngine:
         self.attribution = cfg.attribution
         self._cost_model = None
         self._roofline: dict[str, dict[str, float]] = {}
+        # Measured executable costs from compiled.cost_analysis(), filled
+        # by warmup() (observability/xla_cost.py): the XLA-measured twin
+        # of the analytic cost model above, behind the
+        # distllm_engine_mfu_measured gauges and the calibration ratios.
+        self._measured_costs: dict[str, _xla_cost.XlaCost] = {}
         # Built unconditionally (a cheap metadata walk) so flipping
         # self.attribution ON at runtime works even when the engine was
         # constructed with attribution off.
@@ -925,7 +962,18 @@ class LLMEngine:
         write lands in the reserved trash block — scheduler state and real
         cache contents are untouched. Combine with jax's persistent
         compilation cache to make later processes start hot.
+
+        Every shape in the ladder runs under a compile-watcher phase
+        (docs/observability.md "Startup & compile attribution"): one
+        ``compile`` flight record + ``distllm_compile_seconds{kind,shape}``
+        observation per (kind, batch, bucket), cache-hit marked on the
+        re-warmup / persistent-cache fast paths — so a 22–45 min cold
+        warmup (or a wedge inside it) is attributable shape by shape.
+        Afterwards the warmed serving executables are priced via
+        ``cost_analysis()`` (observability/xla_cost.py) for the measured
+        MFU gauges.
         """
+        watch = self._compile_watcher
         saved_key = self._key  # sampling stream must not observe warmup
         for bucket in self.prefill_buckets:
             cap = self._prefill_batch_cap(bucket)
@@ -936,21 +984,24 @@ class LLMEngine:
                 last_pos = np.zeros((b,), np.int32)
                 lengths = np.zeros((b,), np.int32)  # all writes -> trash
                 block_rows = np.zeros((b, self.max_blocks_per_seq), np.int32)
-                logits, k_all, v_all = self._prefill(
-                    self.params,
-                    self._put(ids),
-                    self._put(mask),
-                    self._put(last_pos),
-                )
-                self.kv.k, self.kv.v = self._write_prefill(
-                    self.kv.k,
-                    self.kv.v,
-                    k_all,
-                    v_all,
-                    self._put(block_rows),
-                    self._put(lengths),
-                )
-                np.asarray(self._sample_device(logits, [None] * b))
+                with watch.phase(
+                    'prefill', f'b{b}x{bucket}', scope=self._compile_scope
+                ):
+                    logits, k_all, v_all = self._prefill(
+                        self.params,
+                        self._put(ids),
+                        self._put(mask),
+                        self._put(last_pos),
+                    )
+                    self.kv.k, self.kv.v = self._write_prefill(
+                        self.kv.k,
+                        self.kv.v,
+                        k_all,
+                        v_all,
+                        self._put(block_rows),
+                        self._put(lengths),
+                    )
+                    np.asarray(self._sample_device(logits, [None] * b))
                 if (
                     self.prefix_cache is not None
                     or self.config.prefill_chunk_tokens
@@ -958,30 +1009,36 @@ class LLMEngine:
                     # Paged-context prefill shapes (cache-hit tails and
                     # chunks dispatch through prefill_paged): tail_lens 0
                     # routes every write to the trash block.
-                    (
-                        ids_dev,
-                        pos_dev,
-                        rows_dev,
-                        ctx_dev,
-                        tails_dev,
-                    ) = self._put_many(
-                        ids,
-                        np.zeros((b, bucket), np.int32),
-                        block_rows,
-                        np.ones((b,), np.int32),
-                        np.zeros((b,), np.int32),
-                    )
-                    pg_logits, self.kv.k, self.kv.v = self._prefill_paged(
-                        self.params,
-                        ids_dev,
-                        pos_dev,
-                        self.kv.k,
-                        self.kv.v,
-                        rows_dev,
-                        ctx_dev,
-                        tails_dev,
-                    )
-                    np.asarray(self._sample_device(pg_logits, [None] * b))
+                    with watch.phase(
+                        'prefill_paged', f'b{b}x{bucket}',
+                        scope=self._compile_scope,
+                    ):
+                        (
+                            ids_dev,
+                            pos_dev,
+                            rows_dev,
+                            ctx_dev,
+                            tails_dev,
+                        ) = self._put_many(
+                            ids,
+                            np.zeros((b, bucket), np.int32),
+                            block_rows,
+                            np.ones((b,), np.int32),
+                            np.zeros((b,), np.int32),
+                        )
+                        pg_logits, self.kv.k, self.kv.v = self._prefill_paged(
+                            self.params,
+                            ids_dev,
+                            pos_dev,
+                            self.kv.k,
+                            self.kv.v,
+                            rows_dev,
+                            ctx_dev,
+                            tails_dev,
+                        )
+                        np.asarray(
+                            self._sample_device(pg_logits, [None] * b)
+                        )
                 if b >= cap:
                     break
                 b *= 2
@@ -991,34 +1048,43 @@ class LLMEngine:
             # self-copy. Without this, the first aligned full-cover cache
             # hit pays the compile inside the very TTFT the cache exists
             # to shrink.
-            src_dev, dst_dev = self._put_many(
-                np.zeros((1,), np.int32), np.zeros((1,), np.int32)
-            )
-            self.kv.k, self.kv.v = self._cow_copy(
-                self.kv.k, self.kv.v, src_dev, dst_dev
-            )
+            with watch.phase('cow_copy', 'b1', scope=self._compile_scope):
+                src_dev, dst_dev = self._put_many(
+                    np.zeros((1,), np.int32), np.zeros((1,), np.int32)
+                )
+                self.kv.k, self.kv.v = self._cow_copy(
+                    self.kv.k, self.kv.v, src_dev, dst_dev
+                )
         bsz = self.config.max_num_seqs
         # Warm the fused decode window: steps_left = 0 freezes every slot,
         # so all KV writes land in the trash block and no state advances.
-        tokens, self.kv.k, self.kv.v, _ = self._decode_window(
-            self.params,
-            self._put(np.zeros((bsz,), np.int32)),
-            self._put(np.zeros((bsz,), np.int32)),
-            self._put(np.ones((bsz,), np.int32)),
-            self.kv.k,
-            self.kv.v,
-            self._put(np.zeros((bsz, self.max_blocks_per_seq), np.int32)),
-            self._put(np.zeros((bsz,), np.int32)),
-            self._put(np.zeros((bsz,), np.float32)),
-            self._put(np.ones((bsz,), np.float32)),
-            self._put(np.zeros((bsz,), np.float32)),
-            jax.random.PRNGKey(0),
-        )
-        self._merge_ids(
-            self._put(np.zeros((bsz,), np.int32)),
-            self._put(np.zeros((bsz,), bool)),
-            self._put(np.zeros((bsz,), np.int32)),
-        )
+        with watch.phase(
+            'decode_window', f'b{bsz}x{self.config.decode_steps}',
+            scope=self._compile_scope,
+        ):
+            tokens, self.kv.k, self.kv.v, _ = self._decode_window(
+                self.params,
+                self._put(np.zeros((bsz,), np.int32)),
+                self._put(np.zeros((bsz,), np.int32)),
+                self._put(np.ones((bsz,), np.int32)),
+                self.kv.k,
+                self.kv.v,
+                self._put(np.zeros((bsz, self.max_blocks_per_seq), np.int32)),
+                self._put(np.zeros((bsz,), np.int32)),
+                self._put(np.zeros((bsz,), np.float32)),
+                self._put(np.ones((bsz,), np.float32)),
+                self._put(np.zeros((bsz,), np.float32)),
+                jax.random.PRNGKey(0),
+            )
+            self._merge_ids(
+                self._put(np.zeros((bsz,), np.int32)),
+                self._put(np.zeros((bsz,), bool)),
+                self._put(np.zeros((bsz,), np.int32)),
+            )
+            # In-phase completion barrier (every other ladder phase ends
+            # with a host fetch): without it the window's async execution
+            # tail would be attributed to whatever phase runs next.
+            np.asarray(tokens)
         if self._mixed_window is not None and not self.config.draft_k:
             # Warm every mixed-window shape the chunk planner can emit
             # — but NOT in speculative mode: _dispatch_window then always
@@ -1040,10 +1106,57 @@ class LLMEngine:
             for bucket in self.prefill_buckets:
                 if bucket > span_bucket:
                     break
-                mixed_tokens, self.kv.k, self.kv.v, _, _ = self._mixed_window(
+                with watch.phase(
+                    'mixed_window', f'b{bsz}x{bucket}c{cb}',
+                    scope=self._compile_scope,
+                ):
+                    mixed_tokens, self.kv.k, self.kv.v, _, _ = (
+                        self._mixed_window(
+                            self.params,
+                            self._put(np.zeros((bsz,), np.int32)),
+                            self._put(np.zeros((bsz,), np.int32)),
+                            self._put(np.ones((bsz,), np.int32)),
+                            self.kv.k,
+                            self.kv.v,
+                            self._put(
+                                np.zeros(
+                                    (bsz, self.max_blocks_per_seq), np.int32
+                                )
+                            ),
+                            self._put(np.zeros((bsz,), np.int32)),
+                            self._put(np.zeros((bsz,), np.float32)),
+                            self._put(np.ones((bsz,), np.float32)),
+                            self._put(np.zeros((bsz,), np.float32)),
+                            jax.random.PRNGKey(0),
+                            self._put(np.zeros((cb, bucket), np.int32)),
+                            self._put(np.zeros((cb, bucket), np.int32)),
+                            self._put(
+                                np.zeros(
+                                    (cb, self.max_blocks_per_seq), np.int32
+                                )
+                            ),
+                            self._put(np.ones((cb,), np.int32)),
+                            self._put(np.zeros((cb,), np.int32)),
+                            self._put(np.zeros((cb,), np.float32)),
+                            self._put(np.ones((cb,), np.float32)),
+                            self._put(np.zeros((cb,), np.float32)),
+                        )
+                    )
+                    np.asarray(mixed_tokens)
+        if self._spec_window is not None:
+            # Warm the speculative verify window: ONE fixed span shape
+            # [B, 1 + draft_k] (rows with shorter drafts pad via
+            # span_lens, so the span dim never adds compiled shapes).
+            # span_lens 0 + all-zero tables route every write to the
+            # trash block; logits/tokens are garbage the host discards.
+            span = 1 + self.config.draft_k
+            with watch.phase(
+                'spec_window', f'b{bsz}s{span}', scope=self._compile_scope
+            ):
+                spec_tokens, self.kv.k, self.kv.v, _ = self._spec_window(
                     self.params,
-                    self._put(np.zeros((bsz,), np.int32)),
-                    self._put(np.zeros((bsz,), np.int32)),
+                    self._put(np.zeros((bsz, span), np.int32)),
+                    self._put(np.zeros((bsz, span), np.int32)),
                     self._put(np.ones((bsz,), np.int32)),
                     self.kv.k,
                     self.kv.v,
@@ -1055,40 +1168,8 @@ class LLMEngine:
                     self._put(np.ones((bsz,), np.float32)),
                     self._put(np.zeros((bsz,), np.float32)),
                     jax.random.PRNGKey(0),
-                    self._put(np.zeros((cb, bucket), np.int32)),
-                    self._put(np.zeros((cb, bucket), np.int32)),
-                    self._put(
-                        np.zeros((cb, self.max_blocks_per_seq), np.int32)
-                    ),
-                    self._put(np.ones((cb,), np.int32)),
-                    self._put(np.zeros((cb,), np.int32)),
-                    self._put(np.zeros((cb,), np.float32)),
-                    self._put(np.ones((cb,), np.float32)),
-                    self._put(np.zeros((cb,), np.float32)),
                 )
-                np.asarray(mixed_tokens)
-        if self._spec_window is not None:
-            # Warm the speculative verify window: ONE fixed span shape
-            # [B, 1 + draft_k] (rows with shorter drafts pad via
-            # span_lens, so the span dim never adds compiled shapes).
-            # span_lens 0 + all-zero tables route every write to the
-            # trash block; logits/tokens are garbage the host discards.
-            span = 1 + self.config.draft_k
-            spec_tokens, self.kv.k, self.kv.v, _ = self._spec_window(
-                self.params,
-                self._put(np.zeros((bsz, span), np.int32)),
-                self._put(np.zeros((bsz, span), np.int32)),
-                self._put(np.ones((bsz,), np.int32)),
-                self.kv.k,
-                self.kv.v,
-                self._put(np.zeros((bsz, self.max_blocks_per_seq), np.int32)),
-                self._put(np.zeros((bsz,), np.int32)),
-                self._put(np.zeros((bsz,), np.float32)),
-                self._put(np.ones((bsz,), np.float32)),
-                self._put(np.zeros((bsz,), np.float32)),
-                jax.random.PRNGKey(0),
-            )
-            np.asarray(spec_tokens)
+                np.asarray(spec_tokens)
         if self._spec_mixed_window is not None:
             # Chunk-carrying spec windows: the same chunk-bucket ladder
             # the mixed warmup walks, beside the fixed spec span.
@@ -1100,41 +1181,171 @@ class LLMEngine:
             for bucket in self.prefill_buckets:
                 if bucket > span_bucket:
                     break
-                spec_tokens, self.kv.k, self.kv.v, _ = (
-                    self._spec_mixed_window(
-                        self.params,
-                        self._put(np.zeros((bsz, span), np.int32)),
-                        self._put(np.zeros((bsz, span), np.int32)),
-                        self._put(np.ones((bsz,), np.int32)),
-                        self.kv.k,
-                        self.kv.v,
-                        self._put(
-                            np.zeros(
-                                (bsz, self.max_blocks_per_seq), np.int32
-                            )
-                        ),
-                        self._put(np.zeros((bsz,), np.int32)),
-                        self._put(np.zeros((bsz,), np.float32)),
-                        self._put(np.ones((bsz,), np.float32)),
-                        self._put(np.zeros((bsz,), np.float32)),
-                        jax.random.PRNGKey(0),
-                        self._put(np.zeros((cb, bucket), np.int32)),
-                        self._put(np.zeros((cb, bucket), np.int32)),
-                        self._put(
-                            np.zeros((cb, self.max_blocks_per_seq), np.int32)
-                        ),
-                        self._put(np.ones((cb,), np.int32)),
-                        self._put(np.zeros((cb,), np.int32)),
-                        self._put(np.zeros((cb,), np.float32)),
-                        self._put(np.ones((cb,), np.float32)),
-                        self._put(np.zeros((cb,), np.float32)),
+                with watch.phase(
+                    'spec_mixed_window', f'b{bsz}s{span}x{bucket}c{cb}',
+                    scope=self._compile_scope,
+                ):
+                    spec_tokens, self.kv.k, self.kv.v, _ = (
+                        self._spec_mixed_window(
+                            self.params,
+                            self._put(np.zeros((bsz, span), np.int32)),
+                            self._put(np.zeros((bsz, span), np.int32)),
+                            self._put(np.ones((bsz,), np.int32)),
+                            self.kv.k,
+                            self.kv.v,
+                            self._put(
+                                np.zeros(
+                                    (bsz, self.max_blocks_per_seq), np.int32
+                                )
+                            ),
+                            self._put(np.zeros((bsz,), np.int32)),
+                            self._put(np.zeros((bsz,), np.float32)),
+                            self._put(np.ones((bsz,), np.float32)),
+                            self._put(np.zeros((bsz,), np.float32)),
+                            jax.random.PRNGKey(0),
+                            self._put(np.zeros((cb, bucket), np.int32)),
+                            self._put(np.zeros((cb, bucket), np.int32)),
+                            self._put(
+                                np.zeros(
+                                    (cb, self.max_blocks_per_seq), np.int32
+                                )
+                            ),
+                            self._put(np.ones((cb,), np.int32)),
+                            self._put(np.zeros((cb,), np.int32)),
+                            self._put(np.zeros((cb,), np.float32)),
+                            self._put(np.ones((cb,), np.float32)),
+                            self._put(np.zeros((cb,), np.float32)),
+                        )
                     )
-                )
-                np.asarray(spec_tokens)
+                    np.asarray(spec_tokens)
         # On this backend block_until_ready does not synchronize; a tiny
         # host fetch is the only reliable completion barrier.
         np.asarray(tokens)
         self._key = saved_key
+        # Price what XLA actually compiled, now that every serving
+        # executable is warm (measured MFU gauges + calibration ratios,
+        # docs/observability.md "Measured vs analytic MFU").
+        self._price_serving_executables()
+
+    def _pricing_allowed(self, fn) -> bool:
+        """Whether pricing ``fn`` via ``lower().compile()`` is safe.
+
+        Already-compiled executables (the TPU auto-layout decode window)
+        are free. Re-lowering a ``jax.jit`` wrapper compiles a second
+        executable with identical HLO — fine on non-TPU backends (tiny
+        compiles) or when the persistent compilation cache will serve it
+        from disk, but never worth a second multi-minute unrolled-window
+        compile on a cold TPU.
+        """
+        if hasattr(fn, 'cost_analysis'):
+            return True
+        if jax.devices()[0].platform != 'tpu':
+            return True
+        try:
+            return bool(jax.config.jax_compilation_cache_dir)
+        except Exception:
+            return False
+
+    def _price_serving_executables(self) -> None:
+        """Store per-kind :class:`~distllm_tpu.observability.xla_cost.
+        XlaCost` for the warmed serving executables — what XLA *measured*
+        for one dispatch of each window kind, as opposed to the analytic
+        ``CostModel``. ``_record_step`` divides these by each window's
+        wall time into the ``distllm_engine_mfu_measured`` gauges and the
+        analytic-vs-measured calibration ratios. Pricing is telemetry:
+        every failure degrades to a telemetry note, never an error.
+
+        The priced shapes are the serving steady state: full-batch
+        decode/spec/mixed windows and the largest prefill shape. Per-kind
+        executable cost is fixed per dispatch (frozen slots still pay),
+        which is exactly the property that makes it *measured truth* —
+        occupancy-dependence lives in the analytic side of the ratio.
+        Only decode and (chunk-less) spec have ONE serving shape, so only
+        they feed the per-dispatch measured gauges (_record_step);
+        prefill/mixed costs are warmup-shape snapshots surfaced via
+        :meth:`measured_costs` alone.
+        """
+        if self._cost_model is None:
+            return
+        cfg = self.config
+        bsz = cfg.max_num_seqs
+
+        def zi(*shape):
+            return self._put(np.zeros(shape, np.int32))
+
+        def oi(*shape):
+            return self._put(np.ones(shape, np.int32))
+
+        def zf(*shape):
+            return self._put(np.zeros(shape, np.float32))
+
+        def of(*shape):
+            return self._put(np.ones(shape, np.float32))
+
+        key = jax.random.PRNGKey(0)
+        bt = zi(bsz, self.max_blocks_per_seq)
+        targets: list[tuple[str, object, tuple]] = []
+        bucket = self.prefill_buckets[-1]
+        pb = self._prefill_batch_cap(bucket)
+        targets.append((
+            'prefill',
+            self._prefill,
+            (self.params, zi(pb, bucket), oi(pb, bucket), zi(pb)),
+        ))
+        targets.append((
+            'decode',
+            self._decode_window,
+            (self.params, zi(bsz), zi(bsz), oi(bsz), self.kv.k, self.kv.v,
+             bt, zi(bsz), zf(bsz), of(bsz), zf(bsz), key),
+        ))
+        if self._spec_window is not None:
+            span = 1 + cfg.draft_k
+            targets.append((
+                'spec',
+                self._spec_window,
+                (self.params, zi(bsz, span), zi(bsz, span), oi(bsz),
+                 self.kv.k, self.kv.v, bt, zi(bsz), zf(bsz), of(bsz),
+                 zf(bsz), key),
+            ))
+        if self._mixed_window is not None and not cfg.draft_k:
+            span_bucket = pick_bucket(
+                self._mixed_span_cap(), self.prefill_buckets
+            )
+            buckets = [bk for bk in self.prefill_buckets if bk <= span_bucket]
+            if buckets:
+                cb, mb = self._mixed_rows(), buckets[-1]
+                targets.append((
+                    'mixed',
+                    self._mixed_window,
+                    (self.params, zi(bsz), zi(bsz), oi(bsz), self.kv.k,
+                     self.kv.v, bt, zi(bsz), zf(bsz), of(bsz), zf(bsz), key,
+                     zi(cb, mb), zi(cb, mb), zi(cb, self.max_blocks_per_seq),
+                     oi(cb), zi(cb), zf(cb), of(cb), zf(cb)),
+                ))
+        for kind, fn, args in targets:
+            try:
+                if not self._pricing_allowed(fn):
+                    self.telemetry.setdefault(
+                        'xla_cost_skipped',
+                        'cold-TPU jit executables not re-lowered; seed the '
+                        'persistent compilation cache to price them',
+                    )
+                    continue
+                cost = _xla_cost.price_callable(fn, *args)
+            except Exception as exc:
+                self.telemetry.setdefault(
+                    'xla_cost_fallback', repr(exc)[:200]
+                )
+                continue
+            if cost is not None:
+                self._measured_costs[kind] = cost
+
+    def measured_costs(self) -> dict[str, dict]:
+        """XLA-measured per-dispatch executable cost by window kind
+        (``{'flops', 'bytes_accessed', 'source'}``; filled by
+        :meth:`warmup`, empty before it or when pricing was skipped) —
+        the measured side of the roofline calibration ratios."""
+        return {k: c.to_dict() for k, c in self._measured_costs.items()}
 
     # ------------------------------------------------------------- requests
     def add_request(
@@ -1889,6 +2100,38 @@ class LLMEngine:
                     'mfu': round(mfu, 5),
                     'bw_util': round(bw_util, 5),
                 }
+                # Measured twin (observability/xla_cost.py): the same
+                # window priced from what XLA actually compiled, plus the
+                # analytic-vs-measured calibration ratio gauges. Published
+                # ONLY for dispatches whose compiled shape is the priced
+                # one: decode always (fixed b x steps), spec when no
+                # chunk rows rode (the chunk-carrying dispatch is a
+                # different executable per bucket). Prefill/mixed dispatch
+                # at varying (batch, bucket) shapes, so publishing the
+                # priced largest-shape cost over a smaller dispatch's
+                # wall time would inflate the gauges by the shape ratio —
+                # their executable costs stay visible via
+                # measured_costs(), never as per-dispatch gauges.
+                fixed_shape = kind == 'decode' or (
+                    kind == 'spec' and not extra.get('prefill_tokens')
+                )
+                measured = (
+                    self._measured_costs.get(kind) if fixed_shape else None
+                )
+                if measured is not None:
+                    m_mfu, m_bw = _xla_cost.publish_measured(
+                        kind, measured, duration_s,
+                        self._cost_model.peak_flops,
+                        self._cost_model.peak_hbm_bytes,
+                    )
+                    _xla_cost.record_calibration(
+                        kind, cost.flops, cost.hbm_bytes, measured
+                    )
+                    extra = {
+                        **extra,
+                        'mfu_measured': round(m_mfu, 5),
+                        'bw_util_measured': round(m_bw, 5),
+                    }
         usable = self.config.num_blocks - 1  # block 0 is reserved
         self.flight.record(
             kind,

@@ -18,7 +18,17 @@ Three layers, all dependency-free:
   tentpole; ``GET /debug/perfetto``, ``perfetto.json`` in bundles);
 - :mod:`~distllm_tpu.observability.roofline` — the analytic FLOPs/bytes
   cost model behind ``distllm_engine_mfu`` and the weight-stream
-  bandwidth-utilization gauges.
+  bandwidth-utilization gauges;
+- :mod:`~distllm_tpu.observability.startup` — startup & compile-phase
+  attribution (ISSUE 11 tentpole): the ``compile`` flight kind,
+  ``distllm_compile_seconds`` series, and dead-phase state for bundles;
+- :mod:`~distllm_tpu.observability.xla_cost` — measured executable cost
+  from ``compiled.cost_analysis()`` behind the
+  ``distllm_engine_mfu_measured`` gauges and the analytic-vs-measured
+  calibration ratios;
+- :mod:`~distllm_tpu.observability.profiling` — the bounded
+  ``jax.profiler`` capture helper (``GET /debug/xprof``,
+  ``DISTLLM_BENCH_PROFILE``).
 
 ``aggregate`` (imported lazily to avoid a cycle with ``timer``) rolls
 multi-host ``[timer]`` logs into one stats table. Metric names and
@@ -51,7 +61,17 @@ from distllm_tpu.observability.perfetto import (
     to_trace_events,
     validate_trace_events,
 )
+from distllm_tpu.observability.profiling import (
+    ProfilerCapture,
+    get_profiler_capture,
+)
 from distllm_tpu.observability.roofline import CostModel, device_peaks
+from distllm_tpu.observability.startup import (
+    CompileWatcher,
+    get_compile_watcher,
+    record_backend_init,
+)
+from distllm_tpu.observability.xla_cost import XlaCost, price_callable
 from distllm_tpu.observability.tracing import (
     Span,
     TraceBuffer,
@@ -65,6 +85,7 @@ from distllm_tpu.observability.tracing import (
 )
 
 __all__ = [
+    'CompileWatcher',
     'CostModel',
     'Counter',
     'Deadline',
@@ -72,23 +93,29 @@ __all__ = [
     'Gauge',
     'Histogram',
     'MetricsRegistry',
+    'ProfilerCapture',
     'RunRecord',
     'Span',
     'StallWatchdog',
     'TraceBuffer',
+    'XlaCost',
     'begin_span',
     'current_request_id',
     'device_peaks',
     'dump_debug_bundle',
     'dump_traces',
     'end_span',
+    'get_compile_watcher',
     'get_flight_recorder',
+    'get_profiler_capture',
     'get_registry',
     'get_trace_buffer',
     'log_buckets',
     'log_event',
     'merge_host_traces',
+    'price_callable',
     'quantile_from_cumulative',
+    'record_backend_init',
     'render_prometheus',
     'request_scope',
     'span',

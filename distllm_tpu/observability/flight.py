@@ -130,8 +130,11 @@ def dump_debug_bundle(
 
     Contents: ``flight.jsonl`` (engine-step ring), ``metrics.prom``
     (Prometheus exposition snapshot), ``traces.jsonl`` (span ring),
-    ``meta.json`` (reason/pid/time/extra), and — best-effort, when a JAX
-    backend is initialized and supports it — ``device_memory.prof``
+    ``startup.json`` (compile-phase records + the phase currently in
+    progress + profiler-capture state — an init-stall bundle names the
+    dead phase instead of arriving empty), ``meta.json``
+    (reason/pid/time/extra), and — best-effort, when a JAX backend is
+    initialized and supports it — ``device_memory.prof``
     (``jax.profiler.save_device_memory_profile``). Every piece is written
     independently: a failure in one never loses the others.
     """
@@ -156,6 +159,28 @@ def dump_debug_bundle(
     try:
         get_trace_buffer().dump_jsonl(traces_path)
         paths['traces'] = str(traces_path)
+    except Exception:
+        pass
+    # Startup/compile attribution + profiler-capture state: the r03/r04
+    # failure mode is a process wedged INSIDE backend init or a warmup
+    # compile — the flight ring is empty then, but the compile watcher's
+    # in-progress phase names exactly where it died. Lazy imports: both
+    # modules import this one.
+    startup_path = directory / 'startup.json'
+    try:
+        from distllm_tpu.observability.profiling import get_profiler_capture
+        from distllm_tpu.observability.startup import get_compile_watcher
+
+        startup_path.write_text(
+            json.dumps(
+                {
+                    'compile': get_compile_watcher().state(),
+                    'profiler': get_profiler_capture().state(),
+                },
+                default=str,
+            )
+        )
+        paths['startup'] = str(startup_path)
     except Exception:
         pass
     # Perfetto/Chrome trace of the same state: drop flight.jsonl's raw

@@ -217,6 +217,63 @@ ENGINE_BW_UTIL = _registry.gauge(
     'every scan step) over wall time and the chip HBM peak.',
     labelnames=('kind',),
 )
+ENGINE_MFU_MEASURED = _registry.gauge(
+    'distllm_engine_mfu_measured',
+    'MFU of the most recent engine step of each kind priced from what XLA '
+    'actually compiled: compiled.cost_analysis() FLOPs '
+    '(observability/xla_cost.py) over wall time and the chip peak — the '
+    'measured twin of distllm_engine_mfu.',
+    labelnames=('kind',),
+)
+ENGINE_BW_UTIL_MEASURED = _registry.gauge(
+    'distllm_engine_bandwidth_utilization_measured',
+    'HBM bandwidth utilization of the most recent engine step of each '
+    'kind from compiled.cost_analysis() bytes accessed — includes KV and '
+    'activation traffic the analytic weight-stream model omits.',
+    labelnames=('kind',),
+)
+ENGINE_ROOFLINE_FLOPS_RATIO = _registry.gauge(
+    'distllm_engine_roofline_flops_ratio',
+    'Measured / analytic FLOPs per dispatch of each kind '
+    '(cost_analysis over the 2 x n_params model) — calibration drift of '
+    'the analytic roofline, as a visible number (~1.0 = calibrated).',
+    labelnames=('kind',),
+)
+ENGINE_ROOFLINE_BYTES_RATIO = _registry.gauge(
+    'distllm_engine_roofline_bytes_ratio',
+    'Measured / analytic HBM bytes per dispatch of each kind — >1.0 is '
+    'expected (KV + activation traffic the weight-stream model omits); '
+    'large jumps mean the compiled graph carries traffic the model '
+    'cannot see (layout churn, materialized slices).',
+    labelnames=('kind',),
+)
+
+# ------------------------------------- startup / compile-phase attribution
+COMPILE_SECONDS = _registry.histogram(
+    'distllm_compile_seconds',
+    'Wall time per startup/compile phase (observability/startup.py), by '
+    'phase kind and shape label — the warmup ladder, backend init, '
+    'weight-layout migration, and quantization made attributable.',
+    labelnames=('kind', 'shape'),
+    buckets=log_buckets(1e-3, 3600.0),
+)
+COMPILE_CACHE_HITS = _registry.counter(
+    'distllm_compile_cache_hits_total',
+    'Compile phases served from a cache fast path: repeat (kind, shape) '
+    'in this process, or zero new persistent-compilation-cache entries '
+    'while a cache dir is configured.',
+)
+
+# ------------------------------------------------ profiler capture helper
+PROFILER_CAPTURES = _registry.counter(
+    'distllm_profiler_captures_total',
+    'Bounded jax.profiler captures (observability/profiling.py; '
+    'GET /debug/xprof, DISTLLM_BENCH_PROFILE), by outcome '
+    'ok/error/rejected.',
+    labelnames=('outcome',),
+)
+for _outcome in ('ok', 'error', 'rejected'):
+    PROFILER_CAPTURES.labels(outcome=_outcome)
 
 # Pre-create the fixed label sets so the full request-lifecycle schema is
 # present in the very first scrape, before any traffic.
@@ -225,6 +282,10 @@ for _kind in ('prefill', 'decode', 'mixed', 'spec'):
     ENGINE_STEP_SECONDS.labels(kind=_kind)
     ENGINE_MFU.labels(kind=_kind)
     ENGINE_BW_UTIL.labels(kind=_kind)
+    ENGINE_MFU_MEASURED.labels(kind=_kind)
+    ENGINE_BW_UTIL_MEASURED.labels(kind=_kind)
+    ENGINE_ROOFLINE_FLOPS_RATIO.labels(kind=_kind)
+    ENGINE_ROOFLINE_BYTES_RATIO.labels(kind=_kind)
 
 # Catalog of FlightRecorder record kinds, mirroring the distllm_* metric-
 # name catalog above: every ``kind`` the package ever passes to
@@ -241,6 +302,28 @@ FLIGHT_KINDS = frozenset({
     'request',  # per-request lifecycle summary at finish
     'preempt',  # recompute preemption performed by prepare_decode
     'event',    # rare irregular events (scheduler exhaustion, ...)
+    'compile',  # one startup/compile phase (observability/startup.py):
+                # backend init, warmup ladder shapes, layout migration
+})
+
+# Catalog of startup/compile phase kinds (observability/startup.py),
+# mirroring FLIGHT_KINDS: every phase name passed to
+# ``CompileWatcher.phase(...)`` must be listed here (enforced by
+# tests/test_lint.py). A phase minted at a call site would fragment the
+# startup schema that debug bundles and the Perfetto startup track replay.
+COMPILE_PHASES = frozenset({
+    'backend_init',       # first jax.devices() touch (PJRT client init)
+    'quantize',           # weight-only quantization of the param tree
+    'auto_layout',        # AOT decode-window compile with Layout.AUTO
+    'migrate_params',     # destructive weight relayout into HBM
+    'kv_allocate',        # paged K/V pool materialization
+    'prefill',            # one (batch, bucket) prefill warmup shape
+    'prefill_paged',      # paged-context prefill twin of that shape
+    'cow_copy',           # prefix-cache copy-on-write block copy
+    'decode_window',      # the fused decode window (+ merge helper)
+    'mixed_window',       # one chunk-bucket mixed-window shape
+    'spec_window',        # the speculative verify window
+    'spec_mixed_window',  # one chunk-bucket spec-mixed shape
 })
 for _outcome in ('met', 'missed'):
     REQUEST_SLO.labels(outcome=_outcome)
@@ -257,6 +340,7 @@ TRACE_EVENT_CATEGORIES = frozenset({
     'host_gap',      # idle gap between consecutive engine windows
     'request',       # per-request lifecycle slice + nested ttft/queue_wait
     'span',          # trace-ring spans (server middleware, RAG, stages)
+    'startup',       # compile-phase slices on the dedicated startup track
 })
 
 # -------------------------------------------------- watchdog / debug bundle

@@ -10,6 +10,10 @@ did the time go" becomes a scroll instead of a probe-script investigation:
   ``spec``), each dispatch a complete slice with its flight fields
   (batch, tokens, MFU, bandwidth utilization, host/put/dispatch/fetch
   split) as args;
+- a ``startup`` track of compile-phase slices (``compile`` flight
+  records from ``observability/startup.py``): backend init, every warmup
+  shape, the weight-layout migration — a wedged init finally shows which
+  shape it died in;
 - a ``host`` track whose slices are the gaps *between* windows — the
   host-side time the chip sat idle, the exact quantity the r5 serving-gap
   hunt had to reconstruct by hand;
@@ -40,9 +44,10 @@ from distllm_tpu.observability.instruments import (
 )
 
 # Fixed tid layout: window-kind tracks first (stable ordering in the UI),
-# then the host-gap track, then dynamically allocated request / thread
-# tracks.
+# then the startup / host-gap tracks, then dynamically allocated request /
+# thread tracks.
 _KIND_TIDS = {'prefill': 1, 'decode': 2, 'mixed': 3, 'spec': 4}
+_STARTUP_TID = 8
 _HOST_TID = 9
 _EVENT_TID = 10
 _REQUEST_TID_BASE = 100
@@ -171,6 +176,18 @@ def to_trace_events(
                 kind, us(start), duration * 1e6,
                 pid, _KIND_TIDS[kind], args, cat='engine_step',
             ))
+        elif kind == 'compile':
+            # Startup track: one slice per compile phase (warmup shapes,
+            # backend init, layout migration — observability/startup.py).
+            # Deliberately NOT a host-gap window: the gap track measures
+            # serving-loop idleness, not the compile ladder.
+            duration = float(record.get('duration_s') or 0.0)
+            start = float(t_wall) - duration
+            name = f"{record.get('phase', 'compile')}:{record.get('shape', '')}"
+            events.append(_slice(
+                name, us(start), duration * 1e6,
+                pid, _STARTUP_TID, args, cat='startup',
+            ))
         elif kind == 'request':
             e2e = record.get('e2e_s')
             if not isinstance(e2e, (int, float)):
@@ -248,6 +265,8 @@ def to_trace_events(
 
     for kind, tid in sorted(_KIND_TIDS.items(), key=lambda kv: kv[1]):
         meta.append(_meta('thread_name', f'engine:{kind}', pid, tid))
+    meta.append(_meta('thread_name', 'startup (compile phases)',
+                      pid, _STARTUP_TID))
     meta.append(_meta('thread_name', 'host (gaps between windows)',
                       pid, _HOST_TID))
     meta.append(_meta('thread_name', 'engine events', pid, _EVENT_TID))

@@ -1,0 +1,139 @@
+"""Bench trajectory gate (``scripts/benchdiff.py``): the fast-tier smoke
+runs it over the REAL in-repo BENCH_r01/r02 records (the known
+embed/gen deltas must appear, exit 0) and over an injected regression
+(exit nonzero) — the acceptance shape of the ISSUE 11 tentpole."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+BENCHDIFF = REPO / 'scripts' / 'benchdiff.py'
+
+_spec = importlib.util.spec_from_file_location('benchdiff', BENCHDIFF)
+benchdiff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(benchdiff)
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, str(BENCHDIFF), *map(str, args)],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_real_r01_r02_records_pass_and_report_known_deltas():
+    """r01 crashed before emitting (no metrics); r02 is the last clean
+    full record: 1619.88 emb/s and 184.18 tok/s appear as new metrics,
+    and a new metric is never a regression."""
+    proc = _run(REPO / 'BENCH_r01.json', REPO / 'BENCH_r02.json')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert '| value |' in out and '1619.88' in out
+    assert '| gen_value |' in out and '184.18' in out
+    assert '| mfu |' in out and '0.463' in out
+    assert 'new' in out
+    assert 'No regressions' in out
+    # r01's empty payload is surfaced, not crashed over.
+    assert 'r01' in out and 'no metrics' in out
+
+
+def test_injected_regression_exits_nonzero(tmp_path):
+    fake = {
+        'n': 6,
+        'rc': 0,
+        'parsed': {
+            'metric': 'embeddings/sec/chip',
+            'value': 1400.0,       # 1619.88 -> 1400: -13.6%
+            'unit': 'emb/s',
+            'gen_value': 100.0,    # 184.18 -> 100: -45.7%
+            'gen_mfu': 0.0135,     # unchanged: must NOT be flagged
+        },
+    }
+    candidate = tmp_path / 'BENCH_r06.json'
+    candidate.write_text(json.dumps(fake))
+    proc = _run(
+        REPO / 'BENCH_r01.json', REPO / 'BENCH_r02.json', candidate,
+        '--markdown', tmp_path / 'trajectory.md',
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert 'REGRESSED' in out
+    assert 'gen_value' in out and '-45.7%' in out
+    assert (tmp_path / 'trajectory.md').read_text() == out
+    # Within-threshold and informational metrics never gate.
+    assert '| gen_mfu |' in out and 'gen_mfu' not in [
+        line.split('`')[1]
+        for line in out.splitlines()
+        if line.startswith('- `')
+    ]
+
+
+def test_threshold_and_direction_semantics(tmp_path):
+    base = tmp_path / 'a.json'
+    base.write_text(json.dumps({
+        'parsed': {'value': 100.0, 'gen_ttft_s': 1.0, 'n_tokens': 500}
+    }))
+
+    def candidate(**metrics):
+        path = tmp_path / 'b.json'
+        path.write_text(json.dumps({'parsed': metrics}))
+        return path
+
+    # Latency is lower-better: a rise beyond threshold regresses...
+    proc = _run(
+        base, candidate(value=100.0, gen_ttft_s=1.5, n_tokens=500)
+    )
+    assert proc.returncode == 1 and 'gen_ttft_s' in proc.stdout
+    # ...a fall (plus a small within-threshold throughput dip) passes.
+    proc = _run(
+        base, candidate(value=98.0, gen_ttft_s=0.5, n_tokens=500)
+    )
+    assert proc.returncode == 0, proc.stdout
+    # Informational counters never gate, even when they collapse.
+    proc = _run(base, candidate(value=100.0, gen_ttft_s=1.0, n_tokens=1))
+    assert proc.returncode == 0, proc.stdout
+    # --strict-missing turns a lost gated metric into a failure.
+    proc = _run(base, candidate(value=100.0))
+    assert proc.returncode == 0
+    proc = _run(base, candidate(value=100.0), '--strict-missing')
+    assert proc.returncode == 1
+
+
+def test_non_finite_metrics_never_crash_or_silently_pass(tmp_path):
+    """bench records round-trip NaN/inf through json (allow_nan): the
+    gate must neither crash formatting them nor let a NaN slide past
+    every threshold comparison — a non-finite value reads as 'not
+    reported' (lost under --strict-missing)."""
+    base = tmp_path / 'a.json'
+    base.write_text(json.dumps({'parsed': {'value': 100.0}}))
+    cand = tmp_path / 'b.json'
+    cand.write_text(json.dumps(
+        {'parsed': {'value': float('nan'), 'gen_value': float('inf')}}
+    ))
+    proc = _run(base, cand)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'Traceback' not in proc.stderr
+    assert 'value' in proc.stdout and 'lost' in proc.stdout
+    proc = _run(base, cand, '--strict-missing')
+    assert proc.returncode == 1
+
+
+def test_library_surface_matches_cli():
+    records = [
+        benchdiff.load_record(REPO / 'BENCH_r01.json'),
+        benchdiff.load_record(REPO / 'BENCH_r02.json'),
+    ]
+    assert records[0]['metrics'] == {}
+    assert records[1]['metrics']['value'] == 1619.88
+    assert records[1]['metrics']['gen_value'] == 184.18
+    regressions, lost = benchdiff.diff_records(records, threshold=0.05)
+    assert regressions == [] and lost == []
+    assert benchdiff.gate_direction('gen_value') == 'higher'
+    assert benchdiff.gate_direction('gen_load_ttft_p95_s') == 'lower'
+    assert benchdiff.gate_direction('warmup_secs') == 'lower'
+    assert benchdiff.gate_direction('n_tokens') is None

@@ -370,10 +370,49 @@ def test_chat_server_bundle_endpoint(chat_server_client, tmp_path, monkeypatch):
     body = requests.get(f'{base}/debug/bundle').json()
     assert body['bundle_dir'].startswith(str(tmp_path))
     paths = body['paths']
-    assert set(paths) >= {'flight', 'metrics', 'traces', 'meta'}
+    assert set(paths) >= {'flight', 'metrics', 'traces', 'meta', 'startup'}
     from pathlib import Path
 
     assert Path(paths['meta']).exists()
     assert 'distllm_engine_generated_tokens_total' in Path(
         paths['metrics']
     ).read_text()
+    startup = json.loads(Path(paths['startup']).read_text())
+    assert 'compile' in startup and 'profiler' in startup
+
+
+def test_chat_server_perfetto_startup_track(chat_server_client):
+    """Compile-phase records from the process watcher surface as the
+    dedicated startup track in GET /debug/perfetto (ISSUE 11 acceptance:
+    a warmup ladder is visible shape by shape)."""
+    import requests
+
+    from distllm_tpu.observability import (
+        get_compile_watcher,
+        validate_trace_events,
+    )
+
+    base = chat_server_client
+    with get_compile_watcher().phase('decode_window', 'b8x16'):
+        pass
+    doc = requests.get(f'{base}/debug/perfetto?limit=500').json()
+    assert validate_trace_events(doc) == []
+    startup = [
+        e for e in doc['traceEvents'] if e.get('cat') == 'startup'
+    ]
+    assert any(e['name'] == 'decode_window:b8x16' for e in startup)
+
+
+def test_chat_server_xprof_endpoint(chat_server_client, tmp_path, monkeypatch):
+    import requests
+
+    monkeypatch.setenv('DISTLLM_DEBUG_DIR', str(tmp_path))
+    base = chat_server_client
+    r = requests.get(f'{base}/debug/xprof?seconds=0.2')
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body['ok'] and body['trace_dir'].startswith(str(tmp_path))
+    assert body['state']['active'] is None
+    assert body['state']['captures_total'] >= 1
+    # Bad input -> 400, never a capture.
+    assert requests.get(f'{base}/debug/xprof?seconds=x').status_code == 400

@@ -339,6 +339,45 @@ def test_trace_event_categories_registered_in_catalog():
     )
 
 
+def test_compile_phase_kinds_registered_in_catalog():
+    """Every startup/compile phase the package opens (a string literal as
+    the first argument of a ``.phase(...)`` call —
+    ``CompileWatcher.phase``) must be registered in
+    ``instruments.COMPILE_PHASES``, mirroring the metric-name /
+    flight-kind / trace-category rules: a phase minted at a call site
+    would fragment the startup schema that debug bundles and the
+    Perfetto startup track replay."""
+    registered = _frozenset_catalog('COMPILE_PHASES')
+    assert registered, (
+        'COMPILE_PHASES parse came back empty — rule is broken'
+    )
+    offenders = []
+    for path in sorted((REPO / 'distllm_tpu').rglob('*.py')):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute) and func.attr == 'phase'
+            ):
+                continue
+            first = node.args[0]
+            if (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value not in registered
+            ):
+                offenders.append(
+                    f'{path.relative_to(REPO)}:{node.lineno} {first.value}'
+                )
+    assert not offenders, (
+        'compile-phase kinds not registered in instruments.COMPILE_PHASES '
+        '(add them there — the catalog is the startup-schema contract):\n'
+        + '\n'.join(sorted(set(offenders)))
+    )
+
+
 @pytest.mark.skipif(shutil.which('ruff') is None, reason='ruff not installed')
 def test_ruff():
     proc = subprocess.run(

@@ -1,0 +1,424 @@
+"""Startup & compile attribution, measured XLA cost, and the bounded
+profiler capture (ISSUE 11 tentpole + satellites): one ``compile`` flight
+record per warmup shape with cache-hit marking on re-warmup, the Perfetto
+startup track, measured-vs-analytic MFU gauges from ``cost_analysis()``,
+``startup.json`` in debug bundles, and capture error-safety."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distllm_tpu.generate.engine.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distllm_tpu.models import mistral
+from distllm_tpu.observability import (
+    CompileWatcher,
+    FlightRecorder,
+    ProfilerCapture,
+    dump_debug_bundle,
+    get_registry,
+    instruments,
+    record_backend_init,
+    to_trace_events,
+    validate_trace_events,
+)
+from distllm_tpu.observability.perfetto import _STARTUP_TID
+
+
+def _tiny_engine(max_model_len=64, **cfg_kwargs):
+    cfg = mistral.MistralConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        intermediate_size=64,
+        dtype='float32',
+    )
+    params = mistral.init(jax.random.PRNGKey(0), cfg)
+
+    class IdTokenizer:
+        eos_id = None
+
+        def decode(self, ids):
+            return ' '.join(str(i) for i in ids)
+
+    engine = LLMEngine(
+        cfg,
+        params,
+        IdTokenizer(),
+        EngineConfig(
+            block_size=4,
+            num_blocks=64,
+            max_num_seqs=4,
+            max_model_len=max_model_len,
+            prefer_native_allocator=False,
+            **cfg_kwargs,
+        ),
+    )
+    # Isolate from the process-global watcher: other tests warm the same
+    # tiny shapes, and process-level dedup would mark them cache hits.
+    recorder = FlightRecorder()
+    engine._compile_watcher = CompileWatcher(recorder=recorder)
+    return engine, recorder
+
+
+def _compile_records(recorder):
+    return [r for r in recorder.snapshot() if r['kind'] == 'compile']
+
+
+# ------------------------------------------------- warmup instrumentation
+def test_warmup_emits_one_compile_record_per_shape():
+    engine, recorder = _tiny_engine()
+    engine.warmup()
+    records = _compile_records(recorder)
+    # The exact ladder: every (batch, bucket) prefill the admission
+    # policy can emit — buckets (16, 32, 64) x batch (1, 2, 4) — plus
+    # the fused decode window. No prefix cache / chunking / mixed / spec
+    # in this config, so nothing else may appear.
+    prefill = [r for r in records if r['phase'] == 'prefill']
+    decode = [r for r in records if r['phase'] == 'decode_window']
+    assert len(prefill) == 9 and len(decode) == 1
+    assert len(records) == 10
+    assert {r['shape'] for r in prefill} == {
+        f'b{b}x{bucket}' for bucket in (16, 32, 64) for b in (1, 2, 4)
+    }
+    assert decode[0]['shape'] == 'b4x8'  # max_num_seqs x decode_steps
+    # One record per shape, none marked as a cache hit on a cold watcher,
+    # every duration real.
+    assert len({(r['phase'], r['shape']) for r in records}) == len(records)
+    assert all(not r['cache_hit'] for r in records)
+    assert all(r['duration_s'] > 0 for r in records)
+    # Timestamps are monotonic: the ladder is sequential, and the
+    # Perfetto startup track depends on the ordering.
+    stamps = [r['t_wall'] for r in records]
+    assert stamps == sorted(stamps)
+
+
+def test_rewarmup_marks_cache_hit_fast_path():
+    engine, recorder = _tiny_engine()
+    engine.warmup()
+    cold = _compile_records(recorder)
+    engine.warmup()
+    warm = _compile_records(recorder)[len(cold):]
+    assert len(warm) == len(cold)
+    assert all(r['cache_hit'] for r in warm)
+    # The fast path is actually fast: jit re-dispatch, not re-compile.
+    assert sum(r['duration_s'] for r in warm) < sum(
+        r['duration_s'] for r in cold
+    )
+
+
+def test_warmup_ladder_includes_paged_shapes_when_chunking():
+    engine, recorder = _tiny_engine(max_model_len=32, prefill_chunk_tokens=16)
+    engine.warmup()
+    records = _compile_records(recorder)
+    prefill = {r['shape'] for r in records if r['phase'] == 'prefill'}
+    paged = {r['shape'] for r in records if r['phase'] == 'prefill_paged'}
+    assert paged == prefill  # every prefill shape has its paged twin
+
+
+def test_warmup_renders_as_perfetto_startup_track():
+    engine, recorder = _tiny_engine()
+    engine.warmup()
+    doc = to_trace_events(recorder.snapshot())
+    assert validate_trace_events(doc) == []
+    startup = [
+        e for e in doc['traceEvents'] if e.get('cat') == 'startup'
+    ]
+    assert len(startup) == len(_compile_records(recorder))
+    # One dedicated track, named slices like 'prefill:b1x16', phase
+    # fields surviving as args.
+    assert {e['tid'] for e in startup} == {_STARTUP_TID}
+    names = {e['name'] for e in startup}
+    assert 'prefill:b1x16' in names and 'decode_window:b4x8' in names
+    assert all(e['args']['cache_hit'] is False for e in startup)
+    track_names = {
+        e['args']['name'] for e in doc['traceEvents']
+        if e['ph'] == 'M' and e['name'] == 'thread_name'
+    }
+    assert 'startup (compile phases)' in track_names
+
+
+# --------------------------------------------------- watcher semantics
+def test_compile_watcher_failure_records_error_not_hit():
+    recorder = FlightRecorder()
+    watch = CompileWatcher(recorder=recorder)
+    with pytest.raises(RuntimeError, match='boom'):
+        with watch.phase('prefill', 'b1x16'):
+            raise RuntimeError('boom')
+    (record,) = _compile_records(recorder)
+    assert 'boom' in record['error']
+    assert not record['cache_hit']
+    # A failed phase must not poison the dedup set: the retry is a real
+    # compile, not a "hit".
+    with watch.phase('prefill', 'b1x16'):
+        pass
+    retry = _compile_records(recorder)[-1]
+    assert 'error' not in retry and not retry['cache_hit']
+    assert watch.state()['active'] is None
+
+
+def test_compile_watcher_names_the_phase_in_progress():
+    """The r03/r04 failure-mode fix: a bundle dumped mid-phase names the
+    exact (kind, shape) the process is stuck in."""
+    watch = CompileWatcher(recorder=FlightRecorder())
+    with watch.phase('decode_window', 'b32x16') as fields:
+        fields['note'] = 'wedged here'
+        active = watch.state()['active']
+        assert active['phase'] == 'decode_window'
+        assert active['shape'] == 'b32x16'
+        assert active['t_start_wall'] <= time.time()
+    assert watch.state()['active'] is None
+    assert watch.state()['phases'][-1]['note'] == 'wedged here'
+
+
+def test_non_compiling_phase_never_claims_persistent_cache_hit(tmp_path):
+    """With a persistent compilation cache dir configured, a phase that
+    does work but no XLA compilation (compiles=False) must not read its
+    zero cache delta as a 'hit' — a cold migrate/allocate would
+    otherwise poison the warm-start evidence."""
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update('jax_compilation_cache_dir', str(tmp_path))
+    try:
+        recorder = FlightRecorder()
+        watch = CompileWatcher(recorder=recorder)
+        with watch.phase('kv_allocate', 'blocks8', compiles=False):
+            pass
+        no_compile = _compile_records(recorder)[-1]
+        assert no_compile['persistent_cache_delta'] == 0
+        assert not no_compile['cache_hit']
+        # A COMPILING phase with zero delta IS the warm-persistent-cache
+        # fast path (nothing new was lowered to disk).
+        with watch.phase('decode_window', 'b1x1'):
+            pass
+        assert _compile_records(recorder)[-1]['cache_hit']
+        # Process-repeat still marks non-compiling phases.
+        with watch.phase('kv_allocate', 'blocks8', compiles=False):
+            pass
+        assert _compile_records(recorder)[-1]['cache_hit']
+    finally:
+        jax.config.update('jax_compilation_cache_dir', old)
+
+
+def test_phase_scope_namespaces_process_dedup():
+    """A second engine in one process builds NEW jit wrappers whose
+    warmup really recompiles — the same (kind, shape) under a fresh
+    scope must not read as a cache hit."""
+    recorder = FlightRecorder()
+    watch = CompileWatcher(recorder=recorder)
+    scope_a, scope_b = watch.new_scope(), watch.new_scope()
+    assert scope_a != scope_b
+    with watch.phase('prefill', 'b1x16', scope=scope_a):
+        pass
+    with watch.phase('prefill', 'b1x16', scope=scope_a):
+        pass
+    with watch.phase('prefill', 'b1x16', scope=scope_b):
+        pass
+    hits = [r['cache_hit'] for r in _compile_records(recorder)]
+    assert hits == [False, True, False]
+
+
+def test_second_engine_sharing_the_watcher_starts_cold():
+    recorder = FlightRecorder()
+    shared = CompileWatcher(recorder=recorder)
+    engine_a, _ = _tiny_engine()
+    engine_a._compile_watcher = shared
+    engine_b, _ = _tiny_engine()
+    engine_b._compile_watcher = shared
+    assert engine_a._compile_scope != engine_b._compile_scope
+    engine_a.warmup()
+    first = _compile_records(recorder)
+    engine_b.warmup()
+    second = _compile_records(recorder)[len(first):]
+    assert {(r['phase'], r['shape']) for r in second} == {
+        (r['phase'], r['shape']) for r in first
+    }
+    assert all(not r['cache_hit'] for r in second)
+
+
+def test_record_backend_init_phase_and_fast_repeat():
+    watch = CompileWatcher(recorder=FlightRecorder())
+    devices = record_backend_init(watch)
+    assert devices[0].platform == 'cpu'
+    first = watch.state()['phases'][-1]
+    assert first['phase'] == 'backend_init'
+    assert first['platform'] == 'cpu'
+    assert first['num_devices'] == len(devices)
+    record_backend_init(watch)
+    assert watch.state()['phases'][-1]['cache_hit']
+
+
+def test_compile_series_in_exposition():
+    """The catalog carries the new series from the first scrape."""
+    text = get_registry().render()
+    for name in (
+        'distllm_compile_seconds',
+        'distllm_compile_cache_hits_total',
+        'distllm_engine_mfu_measured',
+        'distllm_engine_bandwidth_utilization_measured',
+        'distllm_engine_roofline_flops_ratio',
+        'distllm_engine_roofline_bytes_ratio',
+        'distllm_profiler_captures_total',
+    ):
+        assert f'# TYPE {name} ' in text, name
+
+
+# --------------------------------------------------- debug bundle satellite
+def test_debug_bundle_includes_startup_state(tmp_path):
+    paths = dump_debug_bundle(tmp_path / 'bundle', reason='startup test')
+    assert 'startup' in paths
+    state = json.loads((tmp_path / 'bundle' / 'startup.json').read_text())
+    assert set(state) == {'compile', 'profiler'}
+    assert 'active' in state['compile'] and 'phases' in state['compile']
+    assert 'captures_total' in state['profiler']
+
+
+def test_debug_bundle_names_dead_phase_mid_stall(tmp_path):
+    """Bundle dumped while a phase is in flight (the init-stall scenario)
+    attributes the dead phase."""
+    from distllm_tpu.observability.startup import get_compile_watcher
+
+    watch = get_compile_watcher()
+    with watch.phase('migrate_params', 'params'):
+        dump_debug_bundle(tmp_path / 'stall', reason='wedged migrate')
+    state = json.loads((tmp_path / 'stall' / 'startup.json').read_text())
+    assert state['compile']['active']['phase'] == 'migrate_params'
+
+
+# ------------------------------------------- measured XLA cost (xla_cost)
+def test_warmup_prices_executables_from_cost_analysis():
+    engine, _ = _tiny_engine()
+    assert engine.measured_costs() == {}  # warmup fills it
+    engine.warmup()
+    costs = engine.measured_costs()
+    assert set(costs) == {'prefill', 'decode'}
+    for cost in costs.values():
+        assert cost['flops'] > 0
+        assert cost['bytes_accessed'] > 0
+        assert cost['source'] in ('aot', 'lowered')
+
+
+def test_measured_gauges_and_ratios_published_per_step():
+    engine, _ = _tiny_engine()
+    engine.warmup()
+    before = engine.flight.total_recorded
+    engine.generate_ids(
+        [[5, 9, 12]], SamplingParams(temperature=0.0, max_tokens=4)
+    )
+    new = engine.flight.snapshot()[
+        -(engine.flight.total_recorded - before):
+    ]
+    decode = [r for r in new if r['kind'] == 'decode']
+    assert decode, new
+    # Flight records carry the measured twin beside the analytic fields.
+    for record in decode:
+        assert record['mfu_measured'] > 0
+        assert record['bw_util_measured'] > 0
+        assert record['mfu'] > 0
+    # Prefill dispatches at varying (batch, bucket) shapes: the priced
+    # largest-shape executable must NOT be published over their wall
+    # time (it would inflate by the shape ratio) — cost is visible via
+    # measured_costs() only.
+    prefill = [r for r in new if r['kind'] == 'prefill']
+    assert prefill and all('mfu_measured' not in r for r in prefill)
+    # Gauges: measured MFU next to the analytic one, ratios recorded.
+    assert instruments.ENGINE_MFU_MEASURED.labels(kind='decode').value > 0
+    assert (
+        instruments.ENGINE_BW_UTIL_MEASURED.labels(kind='decode').value > 0
+    )
+    flops_ratio = instruments.ENGINE_ROOFLINE_FLOPS_RATIO.labels(
+        kind='decode'
+    ).value
+    bytes_ratio = instruments.ENGINE_ROOFLINE_BYTES_RATIO.labels(
+        kind='decode'
+    ).value
+    assert flops_ratio > 0 and bytes_ratio > 0
+
+
+def test_attribution_off_skips_measured_gauges_but_tokens_identical():
+    on_engine, _ = _tiny_engine()
+    on_engine.warmup()
+    off_engine, _ = _tiny_engine(attribution=False)
+    off_engine.warmup()
+    prompts = [[7, 3, 22, 31]]
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+    on_tokens = on_engine.generate_ids(prompts, sp)
+    before = off_engine.flight.total_recorded
+    assert on_tokens == off_engine.generate_ids(prompts, sp)
+    new = off_engine.flight.snapshot()[
+        -(off_engine.flight.total_recorded - before):
+    ]
+    decode = [r for r in new if r['kind'] == 'decode']
+    assert decode and all('mfu_measured' not in r for r in decode)
+
+
+def test_price_callable_handles_aot_and_failures():
+    from distllm_tpu.observability.xla_cost import price_callable
+
+    jitted = jax.jit(lambda a, b: a @ b)
+    a = np.zeros((16, 16), np.float32)
+    cost = price_callable(jitted, a, a)
+    assert cost is not None and cost.flops > 0
+    assert cost.source == 'lowered'
+    aot = jitted.lower(a, a).compile()
+    cost_aot = price_callable(aot)
+    assert cost_aot is not None and cost_aot.flops == cost.flops
+    assert cost_aot.source == 'aot'
+    # Pricing is telemetry: wrong args degrade to None, never raise.
+    assert price_callable(jitted, np.zeros((3, 5)), np.zeros((7, 2))) is None
+
+
+# ------------------------------------------------- bounded profiler capture
+def test_profiler_capture_bounded_and_rejecting(tmp_path):
+    capture = ProfilerCapture()
+    assert capture.state()['active'] is None
+    assert capture.start(tmp_path / 'trace', max_seconds=30.0)
+    assert capture.state()['active']['log_dir'].endswith('trace')
+    # Second start is rejected, not queued — jax's profiler is global.
+    assert not capture.start(tmp_path / 'other')
+    assert 'already active' in capture.state()['last_error']
+    assert capture.stop()
+    assert capture.state()['active'] is None
+    assert capture.state()['captures_total'] == 1
+    assert not capture.stop()  # idempotent
+
+
+def test_profiler_capture_auto_stops_at_bound(tmp_path):
+    capture = ProfilerCapture()
+    assert capture.start(tmp_path / 'bounded', max_seconds=0.2)
+    deadline = time.monotonic() + 10.0
+    # captures_total increments only after the auto-stop flush completes.
+    while (
+        not capture.state()['captures_total']
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    state = capture.state()
+    assert state['captures_total'] == 1, state
+    assert state['active'] is None
+
+
+def test_profiler_capture_swallows_backend_errors(tmp_path, monkeypatch):
+    """The bench satellite: an unsupported-backend profiler error must
+    not kill the caller."""
+    capture = ProfilerCapture()
+
+    def boom(*args, **kwargs):
+        raise RuntimeError('profiler unsupported on this backend')
+
+    monkeypatch.setattr(jax.profiler, 'start_trace', boom)
+    assert not capture.start(tmp_path / 'nope')
+    assert 'unsupported' in capture.state()['last_error']
+    assert capture.state()['active'] is None
+    result = capture.capture(tmp_path / 'nope2', seconds=0.1)
+    assert not result['ok'] and not result['rejected']
+    assert 'unsupported' in result['error']
