@@ -161,7 +161,20 @@ class EngineConfig(BaseConfig):
     max_prefill_tokens: int = 2048
     # Governs the scheduler implementation (C++ core vs Python twin).
     prefer_native_allocator: bool = True
-    attn_backend: str = 'xla'  # 'xla' | 'pallas' (TPU decode kernel)
+    # Paged-attention kernel selector for EVERY serving dispatch — decode
+    # windows, paged/chunked prefill tails, mixed windows, and
+    # speculative verify spans all route through the one
+    # ops.paged_attention.ragged_paged_attention callsite
+    # (docs/serving.md "Attention kernel backends"). 'xla' is the
+    # always-available bit-exact baseline; 'pallas' is the fused TPU
+    # kernel; 'interpret' runs the same kernel on the Pallas interpreter
+    # (CPU parity tier); 'auto' resolves ONCE at engine construction
+    # (pallas on TPU for CI-covered head dims, else xla) and is pinned
+    # into the jitted serving functions like qmm_backend — a later
+    # config/global change can never re-route live dispatches. The
+    # RESOLVED value is surfaced in engine telemetry and the
+    # distllm_engine_attn_backend_info metric.
+    attn_backend: str = 'xla'  # 'auto' | 'xla' | 'pallas' | 'interpret'
     quantization: str | None = None  # None | 'int8' | 'nf4' (weight-only)
     # Tokens generated per decode dispatch (the fused lax.scan window).
     # 1 restores per-token dispatch; >1 amortizes dispatch+sync latency.
@@ -364,6 +377,17 @@ class EngineConfig(BaseConfig):
             )
         return v
 
+    @field_validator('attn_backend')
+    @classmethod
+    def _known_attn_backend(cls, v: str) -> str:
+        from distllm_tpu.ops.paged_attention import ATTN_BACKENDS
+
+        if v not in ATTN_BACKENDS:
+            raise ValueError(
+                f'attn_backend must be one of {ATTN_BACKENDS}, got {v!r}'
+            )
+        return v
+
 
 class LLMEngine:
     """Drives a Mistral-family decoder with paged KV + continuous batching.
@@ -534,6 +558,54 @@ class LLMEngine:
 
         self._prefill = jax.jit(prefill_fn)
 
+        # Resolve the paged-attention backend ONCE, here, and close every
+        # jitted serving function below over the result — the qmm_backend
+        # pinning pattern (ops.paged_attention.resolve_attn_backend):
+        # 'auto' picks the fused ragged Pallas kernel on TPU for
+        # CI-covered head dims and the always-available XLA baseline
+        # everywhere else, and a config change after construction can
+        # never re-route live dispatches.
+        from distllm_tpu.ops.paged_attention import resolve_attn_backend
+
+        attn_backend = resolve_attn_backend(
+            cfg.attn_backend, model,
+            # 'auto' eligibility includes the kernel's DMA contract on the
+            # KV block geometry — a config the kernel would reject must
+            # resolve to XLA, never trace into a ValueError.
+            block_size=cfg.block_size, kv_dtype=model.dtype,
+        )
+        if mesh is not None and attn_backend != 'xla':
+            # GSPMD cannot partition the ragged pallas_call over the
+            # kv-head-sharded cache planes (the qmm 'pallas' TP rule,
+            # applied to attention). 'auto' quietly keeps the XLA tier —
+            # it partitions like any gather/dot — while an explicit pin
+            # must fail loudly rather than serve a broken partitioning.
+            if cfg.attn_backend == 'auto':
+                attn_backend = 'xla'
+            else:
+                raise ValueError(
+                    f'attn_backend {attn_backend!r} cannot serve under a '
+                    "tensor-parallel mesh; use 'auto'/'xla'"
+                )
+        if (
+            cfg.attn_backend == 'auto'
+            and attn_backend == 'xla'
+            and jax.default_backend() == 'tpu'
+        ):
+            # The fallback is correct but silently costs ~3x decode —
+            # this is the ONE site that sees every reason 'auto' can
+            # land on XLA (head dim, KV block geometry, TP mesh), so the
+            # warning lives here; telemetry carries the resolved value.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "attn_backend='auto' resolved to the XLA paged-attention "
+                'path on a TPU (head_dim %d, block_size %d, tensor '
+                'parallel: %s) — the fused Pallas kernel is not eligible '
+                'for this config',
+                model.head_size, cfg.block_size, mesh is not None,
+            )
+
         # Automatic prefix caching: hash-chain over full prompt blocks,
         # refcounted sharing, LRU eviction (docs/prefix_caching.md).
         # Cache-hit tails and chunked prefills dispatch through
@@ -546,7 +618,7 @@ class LLMEngine:
         def prefill_paged_fn(params, ids, pos, k, v, bt, ctx, tails):
             return mistral.prefill_paged(
                 params, model, ids, pos, k, v, bt, ctx, tails,
-                max_table_positions=_max_tables,
+                max_table_positions=_max_tables, attn_backend=attn_backend,
             )
 
         self._prefill_paged = jax.jit(prefill_paged_fn, donate_argnums=(3, 4))
@@ -560,7 +632,6 @@ class LLMEngine:
             donate_argnums=(0, 1),
         )
 
-        attn_backend = cfg.attn_backend
         num_steps = cfg.decode_steps
         max_tables = cfg.max_model_len
 
@@ -616,6 +687,7 @@ class LLMEngine:
                 temp, top_p, min_p, key,
                 max_table_positions=max_tables,
                 sampling_top_window=cfg.sampling_top_window,
+                attn_backend=attn_backend,
             )
 
         def spec_mixed_fn(
@@ -632,6 +704,7 @@ class LLMEngine:
                 ),
                 max_table_positions=max_tables,
                 sampling_top_window=cfg.sampling_top_window,
+                attn_backend=attn_backend,
             )
 
         self._spec_fn = spec_fn
@@ -646,7 +719,14 @@ class LLMEngine:
         )
         # Resolved-at-serve-time values: a config that believes it enabled
         # the Pallas kernel can otherwise ship 3x slower with no signal.
+        # (attn_backend here is the RESOLVED selector, never 'auto'.)
         self.telemetry: dict[str, str] = {'attn_backend': attn_backend}
+        # Scrape-visible twin of the telemetry field: exactly one backend
+        # label reads 1.
+        for _be in _metrics.ATTN_BACKEND_LABELS:
+            _metrics.ATTN_BACKEND_INFO.labels(backend=_be).set(
+                1.0 if _be == attn_backend else 0.0
+            )
         if cfg.quantization and hasattr(model, 'qmm_backend'):
             self.telemetry['qmm_backend'] = model.qmm_backend
         if (

@@ -47,10 +47,14 @@ class TpuGeneratorConfig(BaseConfig):
     # Defaults are None = inherit EngineConfig's documented defaults, so
     # one place owns each default and reference-parity semantics (exact
     # full-vocab sampling) hold unless a config opts in.
-    attn_backend: Literal['auto', 'xla', 'pallas'] = Field(
+    attn_backend: str = Field(
         default='auto',
-        description="Decode attention kernel: 'auto' = the Pallas kernel "
-        'when the chip and head_dim support it, XLA otherwise.',
+        description="Paged-attention kernel selector: 'auto' = the fused "
+        'ragged Pallas kernel when the chip, head_dim, and KV geometry '
+        "support it, XLA otherwise; 'interpret' runs the kernel on the "
+        'Pallas interpreter (CPU parity tier). Validated against '
+        'ops.paged_attention.ATTN_BACKENDS — the single owner of the '
+        'selector set (docs/serving.md "Attention kernel backends").',
     )
     decode_steps: int | None = Field(
         default=None,
@@ -111,6 +115,20 @@ class TpuGeneratorConfig(BaseConfig):
     )
 
     @model_validator(mode='after')
+    def _attn_backend_in_catalog(self) -> 'TpuGeneratorConfig':
+        # Membership over a Literal copy: the selector set has ONE owner
+        # (instruments.ATTN_BACKEND_LABELS -> ops.ATTN_BACKENDS), so a
+        # new kernel tier is reachable here without touching this file.
+        from distllm_tpu.ops.paged_attention import ATTN_BACKENDS
+
+        if self.attn_backend not in ATTN_BACKENDS:
+            raise ValueError(
+                f'attn_backend must be one of {ATTN_BACKENDS}, '
+                f'got {self.attn_backend!r}'
+            )
+        return self
+
+    @model_validator(mode='after')
     def _spec_requires_greedy(self) -> 'TpuGeneratorConfig':
         if self.draft_k and self.temperature > 0:
             # The acceptance rule compares drafts against the row's OWN
@@ -162,37 +180,6 @@ def _generation_config_eos(model_dir: str) -> tuple[int, ...]:
 
 
 class TpuGenerator:
-    @staticmethod
-    def _resolve_attn_backend(config: TpuGeneratorConfig, model_cfg) -> str:
-        """Resolve 'auto' to a concrete kernel, loudly.
-
-        Eligibility lives with the kernel (`paged_attention.supports_model`
-        — CI-exercised head dims only plus feature support: no softcap /
-        per-layer windows), so widening kernel coverage widens 'auto' in
-        one place. When 'auto' lands on XLA despite a TPU being present, log
-        it: the fallback is correct but silently costs ~3x decode, and the
-        resolved value is also surfaced in engine telemetry as
-        ``attn_backend``.
-        """
-        import jax
-
-        from distllm_tpu.ops.paged_attention import supports_model
-
-        if config.attn_backend != 'auto':
-            return config.attn_backend
-        on_tpu = jax.default_backend() == 'tpu'
-        if on_tpu and supports_model(model_cfg):
-            return 'pallas'
-        if on_tpu:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "attn_backend='auto' resolved to XLA: head_dim %d is "
-                'outside the tested Pallas kernel shapes',
-                model_cfg.head_size,
-            )
-        return 'xla'
-
     def __init__(self, config: TpuGeneratorConfig) -> None:
         import jax
 
@@ -254,7 +241,12 @@ class TpuGenerator:
                 max_num_seqs=config.max_num_seqs,
                 max_model_len=config.max_model_len,
                 quantization=quant_mode,
-                attn_backend=self._resolve_attn_backend(config, model_cfg),
+                # 'auto' is passed THROUGH: the engine resolves it once at
+                # construction (where it also knows the mesh and the KV
+                # block geometry — a pre-resolved 'pallas' would read as
+                # an explicit pin to the engine's TP guard and raise
+                # instead of quietly keeping XLA) and logs the fallback.
+                attn_backend=config.attn_backend,
                 # None = inherit EngineConfig's defaults (single owner).
                 **{
                     knob: value

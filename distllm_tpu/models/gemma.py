@@ -14,9 +14,10 @@ design the engine relies on); Gemma lands as config knobs there:
   ``query_pre_attn_scalar`` score scaling, and the alternating
   local/global sliding-window pattern (even layers windowed).
 
-Serving: Gemma-2's softcap + per-layer windows are outside the Pallas
-paged-attention kernel's contract, so backend 'auto' resolves to the XLA
-path for them (``ops/paged_attention.supports_model``).
+Serving: the ragged Pallas paged-attention kernel natively supports
+Gemma-2's softcap, ``query_pre_attn_scalar`` scale, and traced per-layer
+alternating windows, so backend 'auto' eligibility is purely the head-dim
+CI contract (``ops/paged_attention.supports_model``).
 """
 
 from __future__ import annotations

@@ -338,6 +338,7 @@ def prefill_paged(
     tail_lens: jnp.ndarray,  # [B] valid tokens in input_ids (0 = pad row)
     max_table_positions: int | None = None,
     all_logits: bool = False,
+    attn_backend: str = 'xla',
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefill an UNCACHED TAIL against KV history already in the paged
     cache — the prefix-cache hit / chunked-prefill forward
@@ -347,12 +348,14 @@ def prefill_paged(
     scatter afterwards), the caches ride the layer scan: each layer writes
     its tail K/V into its cache plane FIRST, then the tail queries attend
     over the paged cache — cached prefix and own chunk together — via
-    :func:`~distllm_tpu.ops.paged_attention.ragged_paged_attention_xla`
-    (``q_lens=tail_lens`` — the rows are ragged per-row query spans).
-    Returns ``(last_logits [B, V] fp32, k_cache, v_cache)`` where
-    ``last_logits`` is sampled at each row's last valid tail position.
-    Positions at or past ``tail_lens`` (padding) write to trash block 0
-    and their logits are garbage the caller discards.
+    :func:`~distllm_tpu.ops.paged_attention.ragged_paged_attention`
+    (``q_lens=tail_lens`` — the rows are ragged per-row query spans;
+    ``attn_backend`` selects the XLA baseline or the fused Pallas kernel,
+    resolved once by the engine at construction). Returns
+    ``(last_logits [B, V] fp32, k_cache, v_cache)`` where ``last_logits``
+    is sampled at each row's last valid tail position. Positions at or
+    past ``tail_lens`` (padding) write to trash block 0 and their logits
+    are garbage the caller discards.
 
     ``all_logits=True`` (speculative verification, :func:`spec_window`)
     returns logits at EVERY span position — ``[B, S, V]`` — instead of
@@ -361,7 +364,7 @@ def prefill_paged(
     greedy-identity backbone of docs/speculative.md).
     """
     from distllm_tpu.ops.paged_attention import (
-        ragged_paged_attention_xla,
+        ragged_paged_attention,
         write_chunk_kv,
     )
 
@@ -410,13 +413,14 @@ def prefill_paged(
         k_cache_l, v_cache_l = write_chunk_kv(
             k_cache_l, v_cache_l, k, v, block_tables, positions, valid
         )
-        # q_lens masks PADDING queries onto key 0: under a sliding window
-        # a pad query past the window's reach otherwise has an all-masked
-        # score row -> NaN attention -> NaN K/V written to the TRASH
-        # block -> every later dispatch whose block-table padding gathers
-        # block 0 poisons its softmax·V contraction (0 x NaN = NaN).
-        # Valid rows are bit-identical with or without the mask.
-        attn = ragged_paged_attention_xla(
+        # q_lens masks PADDING queries (XLA: onto key 0; Pallas: to exact
+        # zeros): under a sliding window a pad query past the window's
+        # reach otherwise has an all-masked score row -> NaN attention ->
+        # NaN K/V written to the TRASH block -> every later dispatch
+        # whose block-table padding gathers block 0 poisons its softmax·V
+        # contraction (0 x NaN = NaN). Valid rows are bit-identical with
+        # or without the mask.
+        attn = ragged_paged_attention(
             q, k_cache_l, v_cache_l, block_tables, context_lens, positions,
             q_lens=tail_lens,
             sliding_window=(
@@ -424,6 +428,7 @@ def prefill_paged(
             ),
             scale=getattr(cfg, 'query_scale', None),
             logit_softcap=getattr(cfg, 'attn_logit_softcap', None),
+            backend=attn_backend,
         )
         attn_out = common.dense(
             common.merge_heads(attn), lp['o']['kernel'], qmm_backend=qb
@@ -597,29 +602,14 @@ def _decode_core(
     and the slice traffic amortizes over the whole token batch.
     """
     from distllm_tpu.ops.paged_attention import (
-        paged_attention_pallas,
         paged_attention_xla,
+        ragged_paged_attention_pallas,
         write_token_kv,
     )
 
     alternating = (
         getattr(cfg, 'sliding_window_pattern', 'all') == 'alternating'
     )
-    if attn_backend != 'xla' and (
-        alternating
-        or getattr(cfg, 'attn_logit_softcap', None) is not None
-        or getattr(cfg, 'query_scale', None) is not None
-    ):
-        # The Pallas kernel has no softcap / per-layer-window / custom-
-        # scale support; backend resolution (ops.paged_attention.
-        # supports_model) routes these families to XLA — reaching here
-        # means a config forced 'pallas' explicitly, which must fail
-        # loudly, not serve wrong.
-        raise NotImplementedError(
-            'pallas paged attention does not support logit softcapping, '
-            'alternating sliding windows, or query_scale (gemma2); '
-            'use attn_backend=xla'
-        )
 
     if attn_backend == 'xla':
 
@@ -634,12 +624,19 @@ def _decode_core(
                 logit_softcap=getattr(cfg, 'attn_logit_softcap', None),
             )
     else:
-
+        # A decode row is the ragged kernel's span-1 degenerate case: one
+        # query at the token's own position over the whole context. The
+        # kernel natively handles softcap / traced per-layer windows /
+        # custom scales, so every model family serves through it.
         def attend(q, k_cache_l, v_cache_l, window_l):
-            return paged_attention_pallas(
-                q, k_cache_l, v_cache_l, block_tables, context_lens,
-                sliding_window=cfg.sliding_window,
-            )
+            return ragged_paged_attention_pallas(
+                q[:, None], k_cache_l, v_cache_l, block_tables,
+                context_lens, q_positions=positions[:, None],
+                sliding_window=window_l if alternating else cfg.sliding_window,
+                scale=getattr(cfg, 'query_scale', None),
+                logit_softcap=getattr(cfg, 'attn_logit_softcap', None),
+                interpret=attn_backend == 'interpret',
+            )[:, 0]
 
     # int32 [L] per-layer windows (0 = global) riding the layer scan; only
     # consulted when `alternating`.
@@ -732,8 +729,10 @@ def decode_step(
     K/V written into the paged blocks. Inactive batch slots should point
     their block table rows at the reserved trash block 0.
 
-    ``attn_backend`` selects the XLA gather baseline or the Pallas kernel
-    (both support sliding-window checkpoints via ``cfg.sliding_window``).
+    ``attn_backend`` selects the XLA gather baseline or the fused ragged
+    Pallas kernel (span-1 degenerate case; 'interpret' runs the same
+    kernel on the Pallas interpreter). All backends support sliding
+    windows, gemma2 alternating layers, softcap, and custom scales.
     """
     cos, sin = _rope_tables(cfg, cfg.max_position_embeddings)
     return _decode_core(
@@ -884,7 +883,7 @@ def mixed_window(
     chunk_logits, k_cache, v_cache = prefill_paged(
         params, cfg, chunk_ids, chunk_positions, k_cache, v_cache,
         chunk_block_tables, chunk_context_lens, chunk_tail_lens,
-        max_table_positions=max_table_positions,
+        max_table_positions=max_table_positions, attn_backend=attn_backend,
     )
     chunk_tokens = sample_tokens(
         chunk_logits, chunk_key, chunk_temperature, chunk_top_p,
@@ -919,6 +918,7 @@ def spec_window(
     chunk: tuple | None = None,  # (ids, pos, bt, ctx, tails, temp, tp, mp)
     max_table_positions: int | None = None,
     sampling_top_window: int = 0,
+    attn_backend: str = 'xla',
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray | None]:
     """One SPECULATIVE verify window: score every row's draft span in a
     single ragged dispatch (docs/speculative.md).
@@ -956,6 +956,7 @@ def spec_window(
         chunk_logits, k_cache, v_cache = prefill_paged(
             params, cfg, c_ids, c_pos, k_cache, v_cache, c_bt, c_ctx,
             c_tails, max_table_positions=max_table_positions,
+            attn_backend=attn_backend,
         )
         chunk_tokens = sample_tokens(
             chunk_logits, chunk_key, c_temp, c_top_p, c_min_p,
@@ -965,6 +966,7 @@ def spec_window(
         params, cfg, span_ids, span_positions, k_cache, v_cache,
         block_tables, context_lens, span_lens,
         max_table_positions=max_table_positions, all_logits=True,
+        attn_backend=attn_backend,
     )
     b, s, vocab = span_logits.shape
     flat_tokens = sample_tokens(

@@ -55,6 +55,23 @@ ENGINE_DECODE_UTILIZATION = _registry.histogram(
     'Fraction of decode-window slots generating tokens (batch occupancy).',
     buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
 )
+ATTN_BACKEND_INFO = _registry.gauge(
+    'distllm_engine_attn_backend_info',
+    'Resolved paged-attention kernel backend serving this engine '
+    "(EngineConfig.attn_backend after 'auto' resolution, pinned at "
+    'construction; docs/serving.md "Attention kernel backends"). Exactly '
+    'one backend label reads 1.',
+    labelnames=('backend',),
+)
+# The resolvable (non-'auto') backend labels. This tuple is the single
+# owner: ops.paged_attention derives its legal selector set from it
+# (``ATTN_BACKENDS = ('auto', *ATTN_BACKEND_LABELS)``) and the engine's
+# gauge loop iterates it, so a new kernel tier cannot leave the scrape
+# schema or the 'exactly one label reads 1' invariant behind. Lives here
+# (not in ops) because this module must stay importable without jax.
+ATTN_BACKEND_LABELS = ('xla', 'pallas', 'interpret')
+for _backend in ATTN_BACKEND_LABELS:
+    ATTN_BACKEND_INFO.labels(backend=_backend)
 
 # ------------------------------------------------------------- KV cache
 KV_BLOCKS_TOTAL = _registry.gauge(

@@ -1,4 +1,4 @@
-"""Paged-KV decode attention — the core kernel of the generation engine.
+"""Paged-KV attention — the core kernel of the generation engine.
 
 The reference delegates this to vLLM's CUDA paged-attention
 (``generate/generators/vllm_backend.py``; SURVEY.md section 2.4 N1). Here the
@@ -6,22 +6,33 @@ KV cache lives in HBM as fixed-size blocks::
 
     k_cache, v_cache : [num_blocks, block_size, num_kv_heads, head_dim]
 
-and each decoding sequence owns a row of ``block_tables`` (block ids, padded)
-plus a ``context_lens`` entry (valid tokens). Two implementations share a
-signature:
+and each sequence owns a row of ``block_tables`` (block ids, padded) plus a
+``context_lens`` entry (valid tokens). Every serving dispatch — decode
+windows, mixed prefill+decode, chunked/prefix-cache tail prefill, and
+speculative verification — funnels through the RAGGED per-row-query-span
+formulation, which has two implementations behind one backend selector
+(:func:`ragged_paged_attention`):
 
-- :func:`paged_attention_xla` — gather + masked softmax; XLA fuses this well
-  and it is the portable baseline (also runs on CPU for tests).
-- :func:`paged_attention_pallas` — Pallas TPU kernel: grid over
-  (sequence, KV chunk); block tables are scalar-prefetched and each grid
-  step explicitly DMAs its chunk's pages HBM→VMEM with double buffering
-  (issue chunk c+1 while computing chunk c), online-softmax accumulation
-  in fp32 scratch. Chunks that lie entirely outside a sequence's valid
-  window (beyond ``context_lens`` or before the sliding-window start) are
-  skipped: no DMA, no compute.
+- :func:`ragged_paged_attention_xla` — gather + masked softmax; XLA fuses
+  this well and it is the portable, always-available baseline (also runs on
+  CPU for tests) and the bit-exactness reference.
+- :func:`ragged_paged_attention_pallas` — fused Pallas TPU kernel: grid
+  over (row, query tile, KV chunk); block tables are scalar-prefetched and
+  each grid step explicitly DMAs only the row's live KV pages HBM→VMEM
+  with double buffering (issue chunk c+1 while computing chunk c),
+  online-softmax accumulation in fp32 scratch — no ``[.., S, T]`` score
+  tensor is ever materialized. Chunks outside a row's valid window (beyond
+  ``context_lens``, past the row's last query, or before the
+  sliding-window start) are skipped: no DMA, no compute.
 
-Both handle GQA (query heads grouped over KV heads), sliding windows, and
-fp32 softmax.
+Both handle GQA (query heads grouped natively over KV heads), per-row query
+spans with ``q_lens`` padding masks, static or TRACED sliding windows
+(gemma2 alternating layers), ``logit_softcap``, custom score scales, and
+fp32 softmax/accumulation. A decode row is just the span-1 degenerate case:
+:func:`paged_attention_pallas` is a thin span-1 wrapper over the ragged
+kernel, while :func:`paged_attention_xla` keeps its own dense decode-shaped
+formulation (same math, separately maintained — fixes to the ragged XLA op
+do NOT automatically reach it).
 """
 
 from __future__ import annotations
@@ -30,6 +41,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from distllm_tpu.observability.instruments import ATTN_BACKEND_LABELS
 
 # Head dims the Pallas kernel is exercised at in CI (tests/test_aot_tpu.py
 # compiles these against a real v5e topology). The kernel's structural
@@ -45,20 +58,63 @@ def supported_head_dim(head_dim: int) -> bool:
     return head_dim in TESTED_HEAD_DIMS
 
 
+# Legal values for the engine/generator `attn_backend` selector. 'auto'
+# resolves at engine construction (pinned like qmm_backend, never re-read
+# mid-serve): 'pallas' on TPU when supports_model passes, else 'xla'.
+# 'interpret' runs the SAME ragged Pallas kernel through the Pallas
+# interpreter — CPU-runnable, the parity/identity test tier. The non-'auto'
+# labels are owned by the metrics catalog (one source for the selector set
+# and the distllm_engine_attn_backend_info scrape schema).
+ATTN_BACKENDS = ('auto', *ATTN_BACKEND_LABELS)
+
+
 def supports_model(model_cfg) -> bool:
     """May `attn_backend='auto'` select the Pallas kernel for this model?
 
-    Beyond the head-dim contract, the kernel implements neither attention
-    logit softcapping, nor per-layer (alternating) sliding windows, nor a
-    non-default score scale — gemma2 checkpoints route to XLA regardless
-    of head_dim.
+    The ragged kernel natively implements attention logit softcapping,
+    traced per-layer (gemma2 alternating) sliding windows, and custom
+    score scales, so eligibility is purely the head-dim DMA/CI contract.
     """
-    return (
-        supported_head_dim(model_cfg.head_size)
-        and getattr(model_cfg, 'attn_logit_softcap', None) is None
-        and getattr(model_cfg, 'query_scale', None) is None
-        and getattr(model_cfg, 'sliding_window_pattern', 'all') == 'all'
-    )
+    return supported_head_dim(model_cfg.head_size)
+
+
+def kv_sublane_tile(kv_dtype) -> int:
+    """Sublane-tile rows for a KV-cache dtype (Mosaic: 8 for 4-byte,
+    16 for 2-byte, 32 for 1-byte). The ragged kernel DMAs each page into
+    a ``block_size``-row band of its folded VMEM buffer, so ``block_size``
+    must be a multiple of this."""
+    return max(1, 32 // jnp.dtype(kv_dtype).itemsize)
+
+
+def resolve_attn_backend(
+    attn_backend: str,
+    model_cfg,
+    *,
+    block_size: 'int | None' = None,
+    kv_dtype=None,
+) -> str:
+    """Resolve the ``attn_backend`` selector to a concrete kernel, once.
+
+    Mirrors the ``qmm_backend`` pinning pattern: the engine calls this at
+    construction and closes its jitted serving functions over the result,
+    so a config change after init can never re-route live dispatches.
+    'auto' picks the Pallas kernel on TPU for CI-covered head dims —
+    AND, when the caller provides the KV block geometry, only when
+    ``block_size`` meets the kernel's sublane-tile DMA contract — and
+    falls back to the always-available XLA path everywhere else (an
+    'auto' config must never trace into the kernel's ValueErrors).
+    """
+    if attn_backend not in ATTN_BACKENDS:
+        raise ValueError(
+            f'attn_backend must be one of {ATTN_BACKENDS}, '
+            f'got {attn_backend!r}'
+        )
+    if attn_backend != 'auto':
+        return attn_backend
+    eligible = jax.default_backend() == 'tpu' and supports_model(model_cfg)
+    if eligible and block_size is not None and kv_dtype is not None:
+        eligible = block_size % kv_sublane_tile(kv_dtype) == 0
+    return 'pallas' if eligible else 'xla'
 
 
 def paged_attention_xla(
@@ -127,22 +183,34 @@ def ragged_paged_attention_xla(
     logit_softcap: float | None = None,
 ) -> jnp.ndarray:
     """Ragged per-row-query-length attention over paged KV — the shared
-    kernel of prefix-cache tail prefill, chunked prefill, and mixed
-    prefill+decode serving windows (docs/serving.md).
+    op of prefix-cache tail prefill, chunked prefill, mixed
+    prefill+decode serving windows, and speculative verification
+    (docs/serving.md).
 
     Each row carries a SPAN of queries at absolute ``q_positions``; every
     query attends to all cached positions ``<=`` its own (the span's K/V
     must already be written into the paged blocks — write-then-attend,
-    exactly like the decode path). Rows are ragged: a decode row is a
-    span of length 1 (its single query sees the whole context, 1-vs-
-    context — numerically the :func:`paged_attention_xla` result), while
-    a prefill-chunk row's queries attend causally over chunk + paged
-    prefix. ``q_lens`` (optional) masks each row's padding queries so
-    their softmax rows stay finite; with ``q_lens=None`` padding queries
-    compute garbage the caller discards (masking only touches pad rows —
-    valid rows are bit-identical either way). Gather + masked fp32
-    softmax; XLA fuses this well and it runs on CPU for tests. Prefill
-    spans are compute-bound, so unlike decode there is no Pallas variant.
+    exactly like the decode path). Rows are ragged: a decode row is the
+    span-1 DEGENERATE CASE (its single query at position
+    ``context_lens - 1`` sees the whole context — numerically the
+    :func:`paged_attention_xla` result, though that op keeps its own
+    standalone dense formulation: a masking or numeric fix here must be
+    mirrored there), while a prefill-chunk row's queries attend
+    causally over chunk + paged prefix. ``q_lens`` (optional) masks each
+    row's padding queries so their softmax rows stay finite; with
+    ``q_lens=None`` padding queries compute garbage the caller discards
+    (masking only touches pad rows — valid rows are bit-identical either
+    way). Gather + masked fp32 softmax; XLA fuses this well and it runs
+    on CPU for tests.
+
+    This is the portable baseline and bit-exactness reference of the
+    backend pair: :func:`ragged_paged_attention_pallas` is the fused TPU
+    fast path (grid over row × query tile × KV chunk, online softmax, no
+    dense score tensor), selected per engine via
+    :func:`ragged_paged_attention`'s ``backend`` argument. This XLA path
+    stays the always-available fallback and the identity baseline the
+    parity matrix (``tests/test_ragged_attention.py``) pins the kernel
+    against.
     """
     b, s, num_heads, head_dim = q.shape
     _, block_size, num_kv_heads, _ = k_cache.shape
@@ -213,81 +281,131 @@ def paged_prefill_attention_xla(
     )
 
 
-def _paged_attn_kernel(
+def _ragged_paged_attn_kernel(
     # scalar-prefetch operands (SMEM)
     block_tables_ref,  # [B, max_blocks] int32
     context_lens_ref,  # [B] int32
-    # array operands
-    q_ref,  # [num_heads, head_dim] (VMEM) — one sequence
-    k_cache_ref,  # [num_blocks, block_size, num_kv_heads, head_dim] (HBM)
+    q_start_ref,  # [B] int32 — absolute position of each row's first query
+    q_lens_ref,  # [B] int32 — valid queries per row (0 = fully padded row)
+    window_ref,  # [1] int32 — sliding window; <= 0 disables
+    # array operands. The KV caches arrive HEAD-FOLDED: the caller
+    # bitcast-reshapes [num_blocks, block_size, num_kv_heads, head_dim]
+    # to [num_blocks, block_size, num_kv_heads * head_dim] (row-major —
+    # free), so each KV head occupies a 128-aligned LANE band. This is
+    # the layout trick that retires the Mosaic rejections the decode-only
+    # kernel died on (both reproduced + pinpointed on this container's
+    # toolchain, 2026-08-04): slicing the kv-head dim out of the MIDDLE
+    # of a page buffer (kb[:, h, :]) is an "implicit dim change", and
+    # per-head HBM DMA slices (cache[page, :, h]) break sublane tile
+    # alignment whenever num_kv_heads < the tile — while a static lane
+    # slice at a 128 multiple is always tile-aligned.
+    q_ref,  # [num_kv_heads, span_tile * group, head_dim] (VMEM) — one tile
+    k_cache_ref,  # [num_blocks, block_size, num_kv_heads * head_dim] (HBM)
     v_cache_ref,
-    out_ref,  # [num_heads, head_dim] (VMEM)
-    # scratch
-    k_buf,  # [2, pages_per_chunk, block_size, num_kv_heads, head_dim] VMEM
+    out_ref,  # [num_kv_heads, span_tile * group, head_dim] (VMEM)
+    # scratch — buffers are pre-flattened [slot, chunk_tokens, folded]:
+    # each page DMAs into a statically-offset row band, so the compute
+    # side never reshapes at all (a traced-slot reshape was the third
+    # Mosaic lowering rejection this layout designs out).
+    k_buf,  # [2, chunk_tokens, num_kv_heads * head_dim] VMEM
     v_buf,
     sems,  # DMA semaphores [2, pages_per_chunk, 2]
-    acc_ref,  # [num_heads, head_dim] fp32
-    m_ref,  # [num_heads, 1] fp32
-    l_ref,  # [num_heads, 1] fp32
+    acc_ref,  # [num_kv_heads, span_tile * group, head_dim] fp32
+    m_ref,  # [num_kv_heads, span_tile * group, 128] fp32, lane-replicated
+    l_ref,  # [num_kv_heads, span_tile * group, 128] fp32, lane-replicated
     *,
     block_size: int,
     pages_per_chunk: int,
     num_kv_heads: int,
     group: int,
-    sliding_window: int | None,
+    span_tile: int,
+    scale: float,
+    logit_softcap: float | None,
 ):
-    """Grid (B, num_chunks): one sequence × one chunk of KV pages per step.
+    """Grid (B, q_tiles, kv_chunks): one row × one query tile × one chunk
+    of KV pages per step.
 
-    Pages of a chunk are DMA'd HBM→VMEM individually (they are scattered by
-    the paged allocator), double-buffered across grid steps: while chunk c
-    computes, chunk c+1's copies are in flight. Out-of-range chunks (beyond
-    ``context_lens`` or entirely before the sliding-window start) issue no
-    DMAs and no compute.
+    Pages of a chunk are DMA'd HBM→VMEM individually (they are scattered
+    by the paged allocator), double-buffered across grid steps: while
+    chunk c computes, chunk c+1's copies are in flight. Chunks a tile
+    cannot see — beyond ``context_lens``, past the tile's last query
+    (causality), or entirely before the sliding-window start of its first
+    query — issue no DMAs and no compute, so a decode row (span 1) pays
+    exactly the old decode-only kernel's traffic and a chunk row streams
+    only its causal prefix per tile.
+
+    Online softmax is the flash-attention recurrence per (query, head)
+    lane: running max ``m`` and denominator ``l`` live lane-replicated in
+    fp32 scratch (minor dim 128 — never a 1-wide minor dim, which is what
+    tripped Mosaic's "implicit dim change" lowering on the retired
+    decode-only kernel), the chunk's probabilities are folded into the
+    fp32 accumulator with the usual ``exp(m_prev - m_new)`` correction,
+    and no ``[.., S, T]`` score tensor ever exists.
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     seq = pl.program_id(0)
-    c = pl.program_id(1)
-    num_chunks = pl.num_programs(1)
+    qt = pl.program_id(1)
+    c = pl.program_id(2)
+    num_chunks = pl.num_programs(2)
     ctx = context_lens_ref[seq]
+    q0 = q_start_ref[seq]
+    q_len = q_lens_ref[seq]
+    win = window_ref[0]
     chunk_tokens = pages_per_chunk * block_size
-    num_heads = q_ref.shape[0]
-    head_dim = q_ref.shape[1]
+    head_dim = q_ref.shape[-1]
+    rows = span_tile * group  # query-tile rows per KV head
 
-    # Number of pages this sequence actually uses, and the window floor.
+    # Pages this row actually owns (valid block-table prefix).
     n_pages = (ctx + block_size - 1) // block_size
-    if sliding_window is not None:
-        lo = jnp.maximum(ctx - sliding_window, 0)
-    else:
-        lo = jnp.int32(0)
+    span_off = qt * span_tile  # first span index of this query tile
+    # Keys this tile can ever see: [lo, hi). The tile's FIRST query has
+    # the lowest sliding-window floor; its LAST valid query bounds the
+    # causal ceiling. Fully padded tiles (span_off >= q_len) skip
+    # everything and emit zeros.
+    lo = jnp.where(win > 0, jnp.maximum(q0 + span_off - win + 1, 0), 0)
+    hi = jnp.minimum(ctx, q0 + jnp.minimum(q_len, span_off + span_tile))
+    tile_active = q_len > span_off
 
     def chunk_needed(ci):
         start = ci * chunk_tokens
-        return (start < ctx) & ((ci + 1) * chunk_tokens > lo)
+        return tile_active & (start < hi) & ((ci + 1) * chunk_tokens > lo)
 
     def issue(ci, slot):
-        # Clamp logical page ids into the sequence's valid range: the DMA
+        # Clamp logical page ids into the row's valid range: the DMA
         # engine must copy *something* per issued descriptor, and the
-        # compute mask discards anything outside [lo, ctx).
+        # compute mask discards anything outside [lo, hi). One contiguous
+        # whole-page descriptor per page (the head fold keeps pages
+        # contiguous, so the descriptor count stays 2 per page).
         for p in range(pages_per_chunk):
             logical = ci * pages_per_chunk + p
             page = jnp.clip(logical, 0, jnp.maximum(n_pages - 1, 0))
             page_id = block_tables_ref[seq, page]
+            rows_at = slice(p * block_size, (p + 1) * block_size)
             pltpu.make_async_copy(
-                k_cache_ref.at[page_id], k_buf.at[slot, p], sems.at[slot, p, 0]
+                k_cache_ref.at[page_id],
+                k_buf.at[slot, rows_at],
+                sems.at[slot, p, 0],
             ).start()
             pltpu.make_async_copy(
-                v_cache_ref.at[page_id], v_buf.at[slot, p], sems.at[slot, p, 1]
+                v_cache_ref.at[page_id],
+                v_buf.at[slot, rows_at],
+                sems.at[slot, p, 1],
             ).start()
 
     def wait(slot):
         for p in range(pages_per_chunk):
+            rows_at = slice(p * block_size, (p + 1) * block_size)
             pltpu.make_async_copy(
-                k_cache_ref.at[0], k_buf.at[slot, p], sems.at[slot, p, 0]
+                k_cache_ref.at[0],
+                k_buf.at[slot, rows_at],
+                sems.at[slot, p, 0],
             ).wait()
             pltpu.make_async_copy(
-                v_cache_ref.at[0], v_buf.at[slot, p], sems.at[slot, p, 1]
+                v_cache_ref.at[0],
+                v_buf.at[slot, rows_at],
+                sems.at[slot, p, 1],
             ).wait()
 
     @pl.when(c == 0)
@@ -305,24 +423,36 @@ def _paged_attn_kernel(
     def _():
         issue(c + 1, (c + 1) % 2)
 
-    @pl.when(chunk_needed(c))
-    def _():
-        slot = c % 2
-        wait(slot)
-        scale = jax.lax.rsqrt(jnp.float32(head_dim))
-        kb = k_buf[slot].reshape(chunk_tokens, num_kv_heads, head_dim)
-        vb = v_buf[slot].reshape(chunk_tokens, num_kv_heads, head_dim)
-        positions = c * chunk_tokens + jax.lax.broadcasted_iota(
+    def compute(slot):
+        # ``slot`` is a PYTHON int (the caller branches on chunk parity):
+        # every KV access below is a static-slot, static-lane-band load
+        # straight from the ref. This toolchain's Mosaic rejects a
+        # full-plane bf16 load of the folded buffer ("invalid offsets in
+        # tiling target" — construct-probed 2026-08-04: full-plane f32
+        # loads and per-band bf16 loads both compile; only the
+        # full-plane bf16 load fails), so the head band IS the load.
+        # Per-score-row span index / absolute query position. Query-tile
+        # rows interleave (span, group): row r serves span span_off +
+        # r // group, so GQA head grouping is native — one [rows, C] dot
+        # per KV head scores every query x grouped-head pair at once.
+        span_idx = span_off + (
+            jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // group
+        )  # [rows, 1]
+        qp = q0 + span_idx  # [rows, 1] absolute query positions
+        kvp = c * chunk_tokens + jax.lax.broadcasted_iota(
             jnp.int32, (1, chunk_tokens), 1
-        )
-        valid = positions < ctx
-        if sliding_window is not None:
-            valid = valid & (positions >= lo)
+        )  # [1, C] absolute key positions
+        valid = (kvp < ctx) & (kvp <= qp) & (span_idx < q_len)
+        # Sliding window: query at position p sees keys in (p - win, p];
+        # win <= 0 disables (gemma2 alternating layers ride a traced
+        # per-layer window where 0 means global).
+        valid = valid & ((kvp > qp - win) | (win <= 0))
 
-        q = q_ref[...]
         for h in range(num_kv_heads):  # static unroll over KV heads
-            qh = q[h * group : (h + 1) * group, :]  # [g, Hd]
-            kh = kb[:, h, :]  # [C, Hd]
+            qh = q_ref[h]  # [rows, Hd]
+            # Head h is a static LANE band of the folded buffer — a
+            # 128-aligned slice, always tile-aligned.
+            kh = k_buf[slot, :, h * head_dim:(h + 1) * head_dim]  # [C, Hd]
             scores = (
                 jax.lax.dot_general(
                     qh, kh,
@@ -330,35 +460,290 @@ def _paged_attn_kernel(
                     preferred_element_type=jnp.float32,
                 )
                 * scale
-            )  # [g, C]
+            )  # [rows, C]
+            if logit_softcap is not None:
+                cap = jnp.float32(logit_softcap)
+                scores = jnp.tanh(scores / cap) * cap
             scores = jnp.where(valid, scores, -jnp.inf)
-            m_h = m_ref[h * group : (h + 1) * group, :]  # [g, 1]
-            blk_max = jnp.max(scores, axis=-1, keepdims=True)
-            new_m = jnp.maximum(m_h, blk_max)
-            correction = jnp.exp(
-                jnp.where(m_h == -jnp.inf, -jnp.inf, m_h - new_m)
+            m_prev = m_ref[h]  # [rows, 128] lane-replicated
+            blk_max = jnp.max(scores, axis=-1, keepdims=True)  # [rows, 1]
+            new_m = jnp.maximum(m_prev, blk_max)
+            # A query row can be fully masked in an in-range chunk (the
+            # chunk serves a LATER query of the same tile): keep the
+            # recurrence NaN-free by rebasing on 0 until the row sees its
+            # first live key — exp(-inf - 0) = 0, so l/acc stay 0.
+            safe_m = jnp.where(new_m == -jnp.inf, 0.0, new_m)
+            correction = jnp.exp(m_prev - safe_m)  # m_prev=-inf -> 0
+            probs = jnp.exp(scores - safe_m[:, :1])  # masked lanes -> 0
+            l_ref[h] = l_ref[h] * correction + jnp.sum(
+                probs, axis=-1, keepdims=True
             )
-            probs = jnp.exp(scores - new_m)  # masked lanes: exp(-inf) = 0
-            l_h = l_ref[h * group : (h + 1) * group, :]
-            l_ref[h * group : (h + 1) * group, :] = (
-                l_h * correction + jnp.sum(probs, axis=-1, keepdims=True)
-            )
-            vh = vb[:, h, :]  # [C, Hd]
+            vh = v_buf[slot, :, h * head_dim:(h + 1) * head_dim]  # [C, Hd]
             pv = jax.lax.dot_general(
                 probs.astype(vh.dtype), vh,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )  # [g, Hd]
-            acc_h = acc_ref[h * group : (h + 1) * group, :]
-            acc_ref[h * group : (h + 1) * group, :] = (
-                acc_h * correction + pv
-            )
-            m_ref[h * group : (h + 1) * group, :] = new_m
+            )  # [rows, Hd]
+            acc_ref[h] = acc_ref[h] * correction[:, :1] + pv
+            m_ref[h] = new_m
+
+    @pl.when(chunk_needed(c))
+    def _():
+        wait(c % 2)
+        # Compute is branched on chunk parity so every KV-buffer access
+        # uses a STATIC slot index (DMA descriptors take traced indices
+        # fine — construct-probed). The duplicated trace is two copies
+        # of the same straight-line block — free at runtime, one branch
+        # executes.
+        @pl.when(c % 2 == 0)
+        def _():
+            compute(0)
+
+        @pl.when(c % 2 == 1)
+        def _():
+            compute(1)
 
     @pl.when(c == num_chunks - 1)
     def _():
-        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-9)
-        out_ref[...] = out.astype(out_ref.dtype)
+        # Rows that never saw a live key (q_lens padding, padded tile
+        # tail) have l = 0 and emit exact zeros — finite, so a pad row
+        # can never poison downstream reductions.
+        for h in range(num_kv_heads):
+            out = acc_ref[h] / jnp.maximum(l_ref[h][:, :1], 1e-9)
+            out_ref[h] = out.astype(out_ref.dtype)
+
+
+def ragged_paged_attention_pallas(
+    q: jnp.ndarray,  # [B, S, num_heads, head_dim] per-row query spans
+    k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    context_lens: jnp.ndarray,  # [B] total valid tokens incl. the span
+    q_positions: jnp.ndarray,  # [B, S] absolute position of each query
+    q_lens: 'jnp.ndarray | None' = None,  # [B] valid queries per row
+    sliding_window: 'int | jnp.ndarray | None' = None,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
+    *,
+    pages_per_chunk: int | None = None,
+    span_tile: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused Pallas TPU kernel twin of :func:`ragged_paged_attention_xla`.
+
+    One kernel serves the whole serving surface: decode rows (span 1),
+    prefill-chunk / cache-hit tail rows (causal over chunk + paged
+    prefix), and speculative verify spans, with GQA grouping, ``q_lens``
+    pad-query masking, static or TRACED ``sliding_window`` (gemma2
+    alternating layers; ``<= 0`` disables), ``logit_softcap``, custom
+    ``scale``, and fp32 online-softmax accumulation — never a dense
+    ``[.., S, T]`` score tensor.
+
+    CONTRACT beyond the XLA twin: each row's ``q_positions`` must be
+    CONSECUTIVE (``q_positions[b, i] == q_positions[b, 0] + i``) — true
+    for every serving span (decode rows, chunk tails, verify spans), and
+    what lets the kernel scalar-prefetch one start position per row
+    instead of streaming a position tensor. Pad-query rows (``>=
+    q_lens``) emit exact zeros where the XLA twin emits key-0 garbage;
+    both are finite and both are discarded by every caller, so valid
+    rows are the parity surface (pinned by the interpret-mode matrix in
+    ``tests/test_ragged_attention.py``).
+
+    ``pages_per_chunk`` controls how many KV pages one grid step fetches
+    and computes (default: enough for 128 tokens); ``span_tile`` caps the
+    query-span positions per grid tile (default: up to 512 query rows
+    after GQA flattening) — both bound VMEM. ``interpret=True`` runs the
+    same kernel on the Pallas interpreter (CPU-runnable; the
+    ``attn_backend='interpret'`` engine tier).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, num_heads, head_dim = q.shape
+    num_blocks, block_size, num_kv_heads, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    group = num_heads // num_kv_heads
+    if head_dim % 128 and not interpret:
+        # Mosaic requires HBM DMA slices 128-aligned in the minor dim; the
+        # engine's backend resolution (supports_model) routes such models
+        # to XLA, so reaching here means an explicit 'pallas' pin.
+        raise ValueError(
+            f'pallas paged attention needs head_dim % 128 == 0, got {head_dim}'
+        )
+    # Each page DMAs into a [block_size]-row band of the folded KV buffer,
+    # so the band offsets must land on sublane-tile boundaries (16 rows
+    # for 2-byte dtypes, 8 for fp32). EngineConfig's default block_size of
+    # 16 satisfies every serving dtype, and 'auto' resolution
+    # (resolve_attn_backend with the block geometry) routes misaligned
+    # configs to XLA before ever tracing here — reaching this raise means
+    # an explicit 'pallas' pin.
+    sublane = kv_sublane_tile(k_cache.dtype)
+    if block_size % sublane and not interpret:
+        raise ValueError(
+            f'pallas paged attention needs block_size % {sublane} == 0 '
+            f'for {jnp.dtype(k_cache.dtype).name} KV caches, '
+            f'got {block_size}'
+        )
+    if pages_per_chunk is None:
+        pages_per_chunk = max(1, 128 // block_size)
+    pages_per_chunk = min(pages_per_chunk, max_blocks)
+    num_chunks = -(-max_blocks // pages_per_chunk)
+    if span_tile is None:
+        # ~512 post-GQA query rows per tile keeps q/out/acc + the m/l
+        # scratch + double-buffered KV pages comfortably inside VMEM at
+        # 7B dims while still feeding the MXU full tiles.
+        span_tile = max(1, 512 // group)
+    span_tile = min(span_tile, s)
+    num_q_tiles = -(-s // span_tile)
+
+    if scale is None:
+        scale = head_dim ** -0.5
+    # One compiled signature for every window variant: the sliding window
+    # rides a scalar-prefetch operand whether static, absent (0 = off),
+    # or a traced per-layer value (gemma2 alternating layers).
+    if sliding_window is None:
+        window_arr = jnp.zeros((1,), jnp.int32)
+    else:
+        window_arr = jnp.asarray(sliding_window, jnp.int32).reshape((1,))
+    if q_lens is None:
+        # No pad masking requested: every span position is a live query
+        # (the XLA twin's q_lens=None semantics for valid rows).
+        q_lens = jnp.full((b,), s, jnp.int32)
+
+    # Group-major query layout: [B, S, Nh, Hd] -> [B, Nkv, S*G, Hd] so the
+    # kernel reads one contiguous [rows, Hd] plane per KV head with no
+    # in-kernel reshapes across the head dim (row r = span r//G, group
+    # member r%G). The transpose touches only the tiny activation tensor.
+    qg = q.reshape(b, s, num_kv_heads, group, head_dim)
+    qg = qg.transpose(0, 2, 1, 3, 4).reshape(
+        b, num_kv_heads, s * group, head_dim
+    )
+    # Head-folded cache view: [nb, bs, Nkv, Hd] -> [nb, bs, Nkv*Hd] is a
+    # row-major bitcast (no copy), and inside the kernel each head is a
+    # 128-aligned lane band — the layout that keeps whole-page DMA
+    # descriptors contiguous AND per-head slices tile-aligned (see the
+    # kernel docstring for the two Mosaic rejections this designs out).
+    k_folded = k_cache.reshape(
+        num_blocks, block_size, num_kv_heads * head_dim
+    )
+    v_folded = v_cache.reshape(
+        num_blocks, block_size, num_kv_heads * head_dim
+    )
+
+    rows = span_tile * group
+    kernel = functools.partial(
+        _ragged_paged_attn_kernel,
+        block_size=block_size,
+        pages_per_chunk=pages_per_chunk,
+        num_kv_heads=num_kv_heads,
+        group=group,
+        span_tile=span_tile,
+        scale=float(scale),
+        logit_softcap=(
+            None if logit_softcap is None else float(logit_softcap)
+        ),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(b, num_q_tiles, num_chunks),
+        in_specs=[
+            pl.BlockSpec(
+                (None, num_kv_heads, rows, head_dim),
+                lambda i, qi, j, *_: (i, 0, qi, 0),
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, num_kv_heads, rows, head_dim),
+            lambda i, qi, j, *_: (i, 0, qi, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM(
+                (2, pages_per_chunk * block_size,
+                 num_kv_heads * head_dim),
+                k_cache.dtype,
+            ),
+            pltpu.VMEM(
+                (2, pages_per_chunk * block_size,
+                 num_kv_heads * head_dim),
+                v_cache.dtype,
+            ),
+            pltpu.SemaphoreType.DMA((2, pages_per_chunk, 2)),
+            pltpu.VMEM((num_kv_heads, rows, head_dim), jnp.float32),
+            pltpu.VMEM((num_kv_heads, rows, 128), jnp.float32),
+            pltpu.VMEM((num_kv_heads, rows, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (b, num_kv_heads, s * group, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        q_positions[:, 0].astype(jnp.int32),
+        q_lens.astype(jnp.int32),
+        window_arr,
+        qg,
+        k_folded,
+        v_folded,
+    )
+    return (
+        out.reshape(b, num_kv_heads, s, group, head_dim)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, s, num_heads, head_dim)
+    )
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [B, S, num_heads, head_dim] per-row query spans
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    q_lens: 'jnp.ndarray | None' = None,
+    sliding_window: 'int | jnp.ndarray | None' = None,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
+    *,
+    backend: str = 'xla',
+) -> jnp.ndarray:
+    """THE serving attention callsite: dispatch one ragged paged span
+    batch through the selected backend.
+
+    ``backend`` is a RESOLVED selector value ('xla' | 'pallas' |
+    'interpret' — see :data:`ATTN_BACKENDS`; the engine resolves 'auto'
+    once at construction via :func:`resolve_attn_backend` and closes its
+    jitted serving functions over the result, mirroring ``qmm_backend``).
+    'xla' is the always-available bit-exact baseline; 'pallas' is the
+    fused TPU kernel; 'interpret' runs the same kernel on the Pallas
+    interpreter (CPU parity/identity tests). Every serving dispatch —
+    ``decode_loop``/``decode_step`` span-1 rows, ``prefill_paged`` tails,
+    ``mixed_window`` chunk rows, ``spec_window`` verify spans — routes
+    through here, so one kernel accelerates the whole serving surface.
+    """
+    if backend in ('pallas', 'interpret'):
+        return ragged_paged_attention_pallas(
+            q, k_cache, v_cache, block_tables, context_lens, q_positions,
+            q_lens=q_lens, sliding_window=sliding_window, scale=scale,
+            logit_softcap=logit_softcap, interpret=backend == 'interpret',
+        )
+    if backend != 'xla':
+        raise ValueError(
+            f'unresolved or unknown attn backend {backend!r}; expected '
+            "'xla', 'pallas', or 'interpret' (resolve 'auto' via "
+            'resolve_attn_backend before dispatch)'
+        )
+    return ragged_paged_attention_xla(
+        q, k_cache, v_cache, block_tables, context_lens, q_positions,
+        q_lens=q_lens, sliding_window=sliding_window, scale=scale,
+        logit_softcap=logit_softcap,
+    )
 
 
 def paged_attention_pallas(
@@ -368,76 +753,35 @@ def paged_attention_pallas(
     block_tables: jnp.ndarray,
     context_lens: jnp.ndarray,
     *,
-    sliding_window: int | None = None,
+    sliding_window: 'int | jnp.ndarray | None' = None,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
     pages_per_chunk: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Pallas TPU kernel version of :func:`paged_attention_xla`.
-
-    ``pages_per_chunk`` controls how many KV pages one grid step fetches
-    and computes (default: enough for 128 tokens) — larger chunks amortize
-    DMA-issue overhead and feed the MXU bigger tiles, at the cost of VMEM.
+    """Pallas kernel twin of :func:`paged_attention_xla` — now a thin
+    span-1 wrapper over :func:`ragged_paged_attention_pallas` (a decode
+    row is the ragged kernel's degenerate case: one query at position
+    ``context_lens - 1`` over the whole context). The standalone
+    decode-only kernel this used to be is retired; its block layout
+    tripped Mosaic's "implicit dim change" lowering on some toolchains
+    (xfail-gated since ISSUE 3), which the ragged kernel's lane-friendly
+    layout avoids — ``tests/test_aot_tpu.py`` now compiles it gate-free.
     """
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    b, num_heads, head_dim = q.shape
-    num_blocks, block_size, num_kv_heads, _ = k_cache.shape
-    max_blocks = block_tables.shape[1]
-    group = num_heads // num_kv_heads
-    if head_dim % 128 and not interpret:
-        # Mosaic requires HBM DMA slices 128-aligned in the minor dim; the
-        # engine probes this at warmup and falls back to the XLA path.
-        raise ValueError(
-            f'pallas paged attention needs head_dim % 128 == 0, got {head_dim}'
-        )
-    if pages_per_chunk is None:
-        pages_per_chunk = max(1, 128 // block_size)
-    pages_per_chunk = min(pages_per_chunk, max_blocks)
-    num_chunks = -(-max_blocks // pages_per_chunk)
-
-    kernel = functools.partial(
-        _paged_attn_kernel,
-        block_size=block_size,
-        pages_per_chunk=pages_per_chunk,
-        num_kv_heads=num_kv_heads,
-        group=group,
+    return ragged_paged_attention_pallas(
+        q[:, None],
+        k_cache,
+        v_cache,
+        block_tables,
+        context_lens,
+        q_positions=(context_lens.astype(jnp.int32) - 1)[:, None],
+        q_lens=None,
         sliding_window=sliding_window,
-    )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, num_chunks),
-        in_specs=[
-            pl.BlockSpec(
-                (None, num_heads, head_dim), lambda i, j, *_: (i, 0, 0)
-            ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(
-            (None, num_heads, head_dim), lambda i, j, *_: (i, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM(
-                (2, pages_per_chunk, block_size, num_kv_heads, head_dim),
-                k_cache.dtype,
-            ),
-            pltpu.VMEM(
-                (2, pages_per_chunk, block_size, num_kv_heads, head_dim),
-                v_cache.dtype,
-            ),
-            pltpu.SemaphoreType.DMA((2, pages_per_chunk, 2)),
-            pltpu.VMEM((num_heads, head_dim), jnp.float32),
-            pltpu.VMEM((num_heads, 1), jnp.float32),
-            pltpu.VMEM((num_heads, 1), jnp.float32),
-        ],
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, num_heads, head_dim), q.dtype),
+        scale=scale,
+        logit_softcap=logit_softcap,
+        pages_per_chunk=pages_per_chunk,
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), q, k_cache, v_cache)
+    )[:, 0]
 
 
 def write_token_kv(

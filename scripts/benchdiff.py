@@ -41,17 +41,31 @@ from pathlib import Path
 # Direction of "better" per gated metric. Matching is by substring /
 # suffix on the flattened key; anything unmatched is informational only
 # (shown in the table, never gated) — counts, batch sizes, cache-entry
-# bookkeeping must not fail a round.
+# bookkeeping must not fail a round. 'mfu_measured' / 'bw_util_measured'
+# gate the per-kind XLA-measured roofline columns the gen_kernel A/B
+# stage records (gen_kernel_{xla,pallas}_{mfu,bw_util}_measured,
+# docs/observability.md "Measured vs analytic MFU") so a kernel
+# regression — measured utilization falling on the same workload — trips
+# the trajectory gate even when tok/s noise hides it.
 _LOWER_BETTER_TOKENS = ('ttft', 'tpot', 'queue_wait', 'warmup_secs')
 _HIGHER_BETTER_SUFFIXES = ('value', 'mfu', 'vs_baseline')
-_HIGHER_BETTER_TOKENS = ('goodput', 'accept_rate', 'hit_rate', 'tok_s')
+_HIGHER_BETTER_TOKENS = (
+    'goodput', 'accept_rate', 'hit_rate', 'tok_s', 'mfu_measured',
+    'bw_util_measured',
+)
 
 
 def gate_direction(key: str) -> str | None:
     """``'higher'`` / ``'lower'`` for gated metrics, ``None`` for
     informational ones. Lower-better tokens win ties (``gen_load_ttft_s``
-    is a latency even though the stage also reports values)."""
+    is a latency even though the stage also reports values) — EXCEPT
+    ``speedup``, which outranks them: speedups are ratios-of-latencies
+    named after their numerator (``gen_prefix_ttft_speedup``,
+    ``gen_kernel_speedup``), so the 'ttft' substring alone would gate a
+    warm-start IMPROVEMENT as a regression."""
     k = key.lower()
+    if 'speedup' in k:
+        return 'higher'
     if any(token in k for token in _LOWER_BETTER_TOKENS):
         return 'lower'
     if k.endswith(_HIGHER_BETTER_SUFFIXES):

@@ -241,38 +241,6 @@ def test_decoder_family_dispatch():
         decoder_family('bert')
 
 
-def test_attn_backend_auto_resolution(monkeypatch):
-    """'auto' selects Pallas only for the kernel's tested contract
-    (head_dim == 128 exactly, on a TPU); everything else gets XLA."""
-    from types import SimpleNamespace
-
-    import jax
-
-    from distllm_tpu.generate.generators.tpu_backend import (
-        TpuGenerator,
-        TpuGeneratorConfig,
-    )
-
-    resolve = TpuGenerator._resolve_attn_backend
-    cfg = TpuGeneratorConfig(pretrained_model_name_or_path='/x')
-    mc128 = SimpleNamespace(head_size=128)
-    mc256 = SimpleNamespace(head_size=256)
-
-    # CPU backend: always XLA.
-    assert resolve(cfg, mc128) == 'xla'
-
-    monkeypatch.setattr(jax, 'default_backend', lambda: 'tpu')
-    assert resolve(cfg, mc128) == 'pallas'
-    # head_dim 256 is a multiple of 128 but outside the tested contract.
-    assert resolve(cfg, mc256) == 'xla'
-
-    # Explicit settings are never overridden.
-    explicit = TpuGeneratorConfig(
-        pretrained_model_name_or_path='/x', attn_backend='pallas'
-    )
-    assert resolve(explicit, mc256) == 'pallas'
-
-
 def test_decoder_family_gemma_dispatch():
     from distllm_tpu.models import decoder_family, gemma
 
@@ -288,15 +256,18 @@ def test_decoder_family_gemma_dispatch():
          'attn_logit_softcapping': 50.0, 'final_logit_softcapping': 30.0}
     )
     assert cfg.post_norms and cfg.sliding_window_pattern == 'alternating'
-    # And the Pallas auto-gate refuses softcap models even at head_dim 128.
+    # The Pallas auto-gate is purely the head-dim CI contract now: the
+    # ragged kernel natively supports softcap / alternating windows /
+    # query_scale, so a gemma2 config at head_dim 128 IS eligible while
+    # this 16-head-dim config stays on XLA.
     from types import SimpleNamespace
 
     from distllm_tpu.ops.paged_attention import supports_model
 
-    assert not supports_model(cfg)
+    assert not supports_model(cfg)  # head_dim 16: outside the DMA contract
     assert supports_model(
-        SimpleNamespace(head_size=128, attn_logit_softcap=None,
-                        sliding_window_pattern='all')
+        SimpleNamespace(head_size=128, attn_logit_softcap=50.0,
+                        sliding_window_pattern='alternating')
     )
 
 
