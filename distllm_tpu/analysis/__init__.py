@@ -1,0 +1,44 @@
+"""distlint: dependency-free static analysis for TPU-serving invariants.
+
+Public surface: the framework (:mod:`core`), the rule modules (imported
+here for their registration side effects), and the CLI entry point. See
+``docs/static_analysis.md`` for the rule table, suppression syntax, and
+how to add a rule.
+"""
+
+from distllm_tpu.analysis.core import (
+    META_RULE_IDS,
+    RULES,
+    Diagnostic,
+    Project,
+    Rule,
+    SourceFile,
+    Suppression,
+    analyze,
+    default_source_paths,
+    iter_rules,
+    load_project,
+    register,
+)
+from distllm_tpu.analysis import rules_hygiene  # noqa: F401
+from distllm_tpu.analysis import rules_catalog  # noqa: F401
+from distllm_tpu.analysis import rules_tpu  # noqa: F401
+from distllm_tpu.analysis.cli import JSON_SCHEMA_VERSION, build_report, main
+
+__all__ = [
+    'META_RULE_IDS',
+    'RULES',
+    'Diagnostic',
+    'Project',
+    'Rule',
+    'SourceFile',
+    'Suppression',
+    'analyze',
+    'default_source_paths',
+    'iter_rules',
+    'load_project',
+    'register',
+    'JSON_SCHEMA_VERSION',
+    'build_report',
+    'main',
+]

@@ -1,0 +1,7 @@
+"""``python -m distllm_tpu.analysis`` — the distlint CLI."""
+
+import sys
+
+from distllm_tpu.analysis.cli import main
+
+sys.exit(main())

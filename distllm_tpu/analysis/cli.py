@@ -1,0 +1,177 @@
+"""distlint CLI: text + JSON findings over the repo's lint surface.
+
+``python scripts/distlint.py`` (or ``python -m distllm_tpu.analysis``)
+runs every registered rule over the default source set — the same file
+set and rules tier-1 enforces via ``tests/test_lint.py`` — and exits
+nonzero on findings, so builders get the findings before pytest does.
+
+The JSON output (``--json``) is a stable schema (``version`` bumps on
+breaking change; ``tests/test_analysis.py`` pins it)::
+
+    {
+      "version": 1,
+      "root": "/abs/repo",
+      "files_analyzed": 210,
+      "rules": [{"id": ..., "description": ..., "severity": ...}, ...],
+      "diagnostics": [
+        {"rule_id": ..., "path": ..., "line": ..., "severity": ...,
+         "message": ...}, ...
+      ],
+      "summary": {"total": 3, "by_rule": {"raw-print": 3}}
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from distllm_tpu.analysis.core import (
+    META_RULE_IDS,
+    RULES,
+    analyze,
+    default_source_paths,
+    iter_rules,
+    load_project,
+)
+
+JSON_SCHEMA_VERSION = 1
+
+
+def _find_repo_root(start: Path) -> Path:
+    """Walk up to the directory that contains the package (so the CLI
+    works from any cwd inside the repo); from an unrelated cwd, fall
+    back to the checkout this module itself lives in."""
+    current = start.resolve()
+    for candidate in (current, *current.parents):
+        if (candidate / 'distllm_tpu').is_dir():
+            return candidate
+    return Path(__file__).resolve().parents[2]
+
+
+def build_report(root: Path, paths=None, rule_ids=None) -> dict:
+    """Run the analysis and shape the stable JSON document."""
+    project = load_project(root, paths)
+    rules = iter_rules(rule_ids)
+    diagnostics = analyze(
+        project, rules, audit_suppressions=rule_ids is None
+    )
+    by_rule: dict[str, int] = {}
+    for diag in diagnostics:
+        by_rule[diag.rule_id] = by_rule.get(diag.rule_id, 0) + 1
+    return {
+        'version': JSON_SCHEMA_VERSION,
+        'root': str(Path(root).resolve()),
+        'files_analyzed': len(project.files),
+        'rules': [
+            {
+                'id': rule.id,
+                'description': rule.description,
+                'severity': rule.severity,
+            }
+            for rule in rules
+        ],
+        'diagnostics': [diag.to_dict() for diag in diagnostics],
+        'summary': {
+            'total': len(diagnostics),
+            'by_rule': dict(sorted(by_rule.items())),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='distlint',
+        description=(
+            'dependency-free static analysis for distllm-tpu serving '
+            'invariants (docs/static_analysis.md)'
+        ),
+    )
+    parser.add_argument(
+        'paths', nargs='*',
+        help='files to analyze (default: the whole lint surface)',
+    )
+    parser.add_argument(
+        '--root', default=None,
+        help='repo root (default: discovered from cwd)',
+    )
+    parser.add_argument(
+        '--rules', default=None,
+        help='comma-separated rule ids to run (default: all)',
+    )
+    parser.add_argument(
+        '--json', action='store_true', dest='as_json',
+        help='emit the JSON report instead of text lines',
+    )
+    parser.add_argument(
+        '--list-rules', action='store_true',
+        help='list registered rule ids and exit',
+    )
+    args = parser.parse_args(argv)
+
+    root = (
+        Path(args.root) if args.root else _find_repo_root(Path.cwd())
+    )
+    if args.list_rules:
+        for rule in iter_rules():
+            # distlint: disable=raw-print -- CLI stdout is the product here, not telemetry
+            print(f'{rule.id:28s} {rule.description}')
+        for meta_id in META_RULE_IDS:
+            # distlint: disable=raw-print -- CLI stdout is the product here, not telemetry
+            print(f'{meta_id:28s} (framework meta rule)')
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(',') if r.strip()]
+        unknown = sorted(set(rule_ids) - set(RULES))
+        if unknown:
+            sys.stderr.write(
+                f'unknown rule ids: {", ".join(unknown)} '
+                f'(see --list-rules)\n'
+            )
+            return 2
+
+    paths = [Path(p) for p in args.paths] if args.paths else None
+    if paths is not None:
+        missing = [p for p in paths if not p.is_file()]
+        if missing:
+            # Usage error, NOT exit 1 — a typo'd path must stay
+            # distinguishable from "findings found".
+            sys.stderr.write(
+                'no such file: '
+                + ', '.join(str(p) for p in missing) + '\n'
+            )
+            return 2
+    else:
+        resolved = default_source_paths(root)
+        if not resolved:
+            sys.stderr.write(f'no sources found under {root}\n')
+            return 2
+
+    report = build_report(root, paths, rule_ids)
+    if args.as_json:
+        # distlint: disable=raw-print -- CLI stdout is the product here, not telemetry
+        print(json.dumps(report, indent=2))
+    else:
+        for diag in report['diagnostics']:
+            # distlint: disable=raw-print -- CLI stdout is the product here, not telemetry
+            print(
+                f'{diag["path"]}:{diag["line"]}: {diag["severity"]}: '
+                f'[{diag["rule_id"]}] {diag["message"]}'
+            )
+        total = report['summary']['total']
+        checked = report['files_analyzed']
+        # distlint: disable=raw-print -- CLI stdout is the product here, not telemetry
+        print(
+            f'distlint: {total} finding(s) across {checked} file(s)'
+            if total
+            else f'distlint: clean ({checked} files analyzed)'
+        )
+    return 1 if report['summary']['total'] else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Hygiene rules migrated from the legacy ``tests/test_lint.py`` walks.
+
+Three rules: unused imports (ruff F401 equivalent), the raw-``print``
+telemetry ban, and the ``.free(`` block-lifecycle ban. Behavior matches
+the legacy tests bit-for-bit (same allowlists, same ``noqa`` handling)
+so the migration cannot loosen the gate; the only addition is the
+structured ``# distlint: disable=...`` escape hatch shared by every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distllm_tpu.analysis.core import (
+    Project,
+    Rule,
+    SourceFile,
+    register,
+)
+
+
+@register
+class UnusedImportRule(Rule):
+    """No module may carry unused imports — the most common rot this repo
+    can accumulate. ``# noqa: F401`` (or a blanket ``# noqa``) on the
+    import line exempts deliberate side-effect imports, matching ruff."""
+
+    id = 'unused-import'
+    description = 'imported name is never used in the module'
+
+    def applies(self, source: SourceFile) -> bool:
+        # Package surfaces re-export by design.
+        return not source.rel.endswith('__init__.py')
+
+    @staticmethod
+    def _imported_names(source: SourceFile):
+        for node in source.nodes():
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split('.')[0]
+                    yield node.lineno, name
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == '__future__':
+                    continue
+                for alias in node.names:
+                    if alias.name == '*':
+                        continue
+                    yield node.lineno, alias.asname or alias.name
+
+    @staticmethod
+    def _used_names(source: SourceFile) -> set[str]:
+        used: set[str] = set()
+        for node in source.nodes():
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                inner = node
+                while isinstance(inner, ast.Attribute):
+                    inner = inner.value
+                if isinstance(inner, ast.Name):
+                    used.add(inner.id)
+            elif isinstance(node, ast.Assign):
+                # Names re-exported via __all__ strings count as used.
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == '__all__':
+                        for el in getattr(node.value, 'elts', []):
+                            if isinstance(el, ast.Constant):
+                                used.add(str(el.value))
+        return used
+
+    def check(self, source: SourceFile, project: Project):
+        assert source.tree is not None
+        used = self._used_names(source)
+        for lineno, name in self._imported_names(source):
+            if name in used:
+                continue
+            line = (
+                source.lines[lineno - 1]
+                if lineno - 1 < len(source.lines)
+                else ''
+            )
+            # Only an F401 (or blanket) noqa exempts an unused import; a
+            # noqa for an unrelated rule (e.g. E402) must not mask rot.
+            if 'noqa: F401' in line or line.rstrip().endswith('# noqa'):
+                continue  # deliberate side-effect import
+            yield self.diag(source, lineno, f'unused import {name!r}')
+
+
+@register
+class RawPrintRule(Rule):
+    """Telemetry goes through ``observability.log_event`` (counted,
+    greppable), not bare ``print(`` — which bypasses the metrics registry
+    and is invisible to scrapes. Only ``timer.py`` (the legacy ``[timer]``
+    line emitter) and the ``observability`` package itself may print;
+    anything else needs a justified suppression (e.g. a CLI whose stdout
+    is the product)."""
+
+    id = 'raw-print'
+    description = 'bare print() telemetry outside the sanctioned emitters'
+
+    _EXEMPT_PREFIXES = ('distllm_tpu/observability/',)
+    _EXEMPT_FILES = ('distllm_tpu/timer.py',)
+
+    def applies(self, source: SourceFile) -> bool:
+        if not self.in_package(source):
+            return False
+        if source.rel in self._EXEMPT_FILES:
+            return False
+        return not source.rel.startswith(self._EXEMPT_PREFIXES)
+
+    def check(self, source: SourceFile, project: Project):
+        assert source.tree is not None
+        for node in source.nodes():
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == 'print'
+            ):
+                yield self.diag(
+                    source,
+                    node.lineno,
+                    'raw print( telemetry — use '
+                    'distllm_tpu.observability.log_event',
+                )
+
+
+@register
+class DirectFreeRule(Rule):
+    """KV blocks are freed ONLY by the allocator/scheduler/prefix-cache
+    machinery (``generate/engine/kv_cache.py`` + the scheduler bindings).
+    A stray ``allocator.free(...)`` anywhere else can double-free a block
+    that the prefix cache still maps — corruption that surfaces as
+    another request's KV, long after the bad call."""
+
+    id = 'direct-free'
+    description = '.free( call outside the allocator/cache modules'
+
+    _ALLOWED = (
+        'distllm_tpu/generate/engine/kv_cache.py',
+        'distllm_tpu/generate/engine/scheduler.py',
+    )
+
+    def applies(self, source: SourceFile) -> bool:
+        return self.in_package(source) and source.rel not in self._ALLOWED
+
+    def check(self, source: SourceFile, project: Project):
+        assert source.tree is not None
+        for node in source.nodes():
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == 'free'
+            ):
+                yield self.diag(
+                    source,
+                    node.lineno,
+                    'direct .free( call — route block lifecycle through '
+                    'the scheduler/PrefixCache',
+                )

@@ -2752,6 +2752,7 @@ class LLMEngine:
         """
         t_fetch = time.monotonic()
         with self._annotate('fetch'):
+            # distlint: disable=host-sync-in-hot-path -- the spec window's ONE designed fetch point: acceptance needs all 1+draft_k verified tokens on host, and spec windows process synchronously (depth 1)
             tokens = np.asarray(window['tokens'])  # [B, S]
         fetch_s = time.monotonic() - t_fetch
         emitted: list[tuple[int, int]] = []
@@ -2832,6 +2833,7 @@ class LLMEngine:
             return self._process_spec_window(window)
         t_fetch = time.monotonic()
         with self._annotate('fetch'):
+            # distlint: disable=host-sync-in-hot-path -- the window loop's ONE designed fetch point: processing happens a window late, after the next dispatch is already in flight (pipeline_depth hides this sync)
             tokens = np.asarray(window['tokens'])  # [K, B]
         fetch_s = time.monotonic() - t_fetch
         emitted: list[tuple[int, int]] = []
@@ -2886,6 +2888,7 @@ class LLMEngine:
         emitted: list[tuple[int, int]] = []
         if not chunk_entries:
             return emitted
+        # distlint: disable=host-sync-in-hot-path -- the mixed window's designed chunk-token fetch: runs after the caller's token fetch already synced this window, so no extra device round-trip is added
         chunk_tokens = np.asarray(window['chunk_tokens'])
         for row_i, rid, start, ntok, final in chunk_entries:
             request = self._requests.get(rid)

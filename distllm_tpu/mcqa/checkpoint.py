@@ -34,8 +34,8 @@ class CheckpointManager:
         self.every = max(1, every)
         self.save_incremental = save_incremental
         self._lock = threading.Lock()
-        self.results: dict[int, dict[str, Any]] = {}
-        self._since_save = 0
+        self.results: dict[int, dict[str, Any]] = {}  # guarded by self._lock
+        self._since_save = 0  # guarded by self._lock
 
     # ---------------------------------------------------------------- save
     def record(self, index: int, result: dict[str, Any]) -> None:
@@ -52,7 +52,7 @@ class CheckpointManager:
         with self._lock:
             return self._save_locked()
 
-    def _save_locked(self) -> Path:
+    def _save_locked(self) -> Path:  # guarded by self._lock
         payload = {
             'version': CHECKPOINT_VERSION,
             'timestamp': time.time(),

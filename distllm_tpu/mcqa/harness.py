@@ -48,7 +48,7 @@ class _PlainProgress:
 
     def __init__(self, total: int) -> None:
         self.total = total
-        self.count = 0
+        self.count = 0  # guarded by self._lock
         self._lock = threading.Lock()
 
     def update(self, n: int = 1) -> None:

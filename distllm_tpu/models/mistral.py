@@ -292,7 +292,7 @@ def _attn_mask(attention_mask: jnp.ndarray, cfg: MistralConfig) -> jnp.ndarray:
     return causal[None, None] & attention_mask[:, None, None, :].astype(bool)
 
 
-def apply(
+def apply(  # distlint: traced
     params: dict,
     cfg: MistralConfig,
     input_ids: jnp.ndarray,
@@ -316,7 +316,7 @@ def apply(
     return hidden
 
 
-def prefill(
+def prefill(  # distlint: traced
     params: dict,
     cfg: MistralConfig,
     input_ids: jnp.ndarray,
@@ -326,7 +326,7 @@ def prefill(
     return _forward(params, cfg, input_ids, attention_mask, collect_kv=True)
 
 
-def prefill_paged(
+def prefill_paged(  # distlint: traced
     params: dict,
     cfg: MistralConfig,
     input_ids: jnp.ndarray,  # [B, S] uncached tail tokens (padded)
@@ -711,7 +711,7 @@ def _decode_core(
     return logits(params, cfg, hidden), k_cache, v_cache
 
 
-def decode_step(
+def decode_step(  # distlint: traced
     params: dict,
     cfg: MistralConfig,
     input_ids: jnp.ndarray,  # [B] one new token per sequence
@@ -741,7 +741,7 @@ def decode_step(
     )
 
 
-def decode_loop(
+def decode_loop(  # distlint: traced
     params: dict,
     cfg: MistralConfig,
     input_ids: jnp.ndarray,  # [B] last emitted token per slot
@@ -822,7 +822,7 @@ def decode_loop(
     return tokens, k_cache, v_cache, ids
 
 
-def mixed_window(
+def mixed_window(  # distlint: traced
     params: dict,
     cfg: MistralConfig,
     # --- decode operands (identical to decode_loop) ---
@@ -899,7 +899,7 @@ def mixed_window(
     return tokens, k_cache, v_cache, last_ids, chunk_tokens
 
 
-def spec_window(
+def spec_window(  # distlint: traced
     params: dict,
     cfg: MistralConfig,
     # --- ragged verify-span operands (prefill_paged shapes) ---
@@ -980,7 +980,7 @@ def spec_window(
     return flat_tokens.reshape(b, s), k_cache, v_cache, chunk_tokens
 
 
-def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray:
+def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray:  # distlint: traced
     """LM head: ``[..., H]`` hidden → fp32 ``[..., V]`` logits."""
     if cfg.tie_word_embeddings or 'lm_head' not in params:
         kernel = jnp.asarray(params['embed']).T

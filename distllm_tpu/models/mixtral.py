@@ -109,7 +109,7 @@ def init(rng: jax.Array, cfg: MixtralConfig) -> dict:
     return params
 
 
-def moe_mlp(
+def moe_mlp(  # distlint: traced
     x: jnp.ndarray,  # [B, S, H]
     router_kernel: jnp.ndarray,  # [H, E]
     gate: jnp.ndarray,  # [E, H, I]

@@ -59,10 +59,10 @@ class FlightRecorder:
         if capacity < 1:
             raise ValueError('capacity must be >= 1')
         self.capacity = capacity
-        self._records: deque[dict] = deque(maxlen=capacity)
+        self._records: deque[dict] = deque(maxlen=capacity)  # guarded by self._lock
         self._lock = threading.Lock()
-        self._recorded = 0
-        self._last_record_monotonic = time.monotonic()
+        self._recorded = 0  # guarded by self._lock
+        self._last_record_monotonic = time.monotonic()  # guarded by self._lock
 
     def record(self, kind: str, **fields) -> dict:
         entry = {'kind': kind, 't_wall': time.time(), **fields}

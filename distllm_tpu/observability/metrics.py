@@ -128,7 +128,7 @@ def _label_suffix(labelnames: tuple[str, ...], labelvalues: tuple[str, ...],
 class _CounterChild:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded by self._lock
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
@@ -145,7 +145,7 @@ class _CounterChild:
 class _GaugeChild:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded by self._lock
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -169,8 +169,8 @@ class _HistogramChild:
     def __init__(self, buckets: tuple[float, ...]) -> None:
         self._lock = threading.Lock()
         self.buckets = buckets
-        self._counts = [0] * (len(buckets) + 1)  # last slot = +Inf
-        self._sum = 0.0
+        self._counts = [0] * (len(buckets) + 1)  # guarded by self._lock (last slot = +Inf)
+        self._sum = 0.0  # guarded by self._lock
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -225,7 +225,7 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._children: dict[tuple[str, ...], object] = {}
+        self._children: dict[tuple[str, ...], object] = {}  # guarded by self._lock
         self._lock = threading.Lock()
         if not self.labelnames:
             self._children[()] = self._new_child()
@@ -251,6 +251,7 @@ class _Metric:
             raise ValueError(
                 f'{self.name} is labeled {self.labelnames}; use .labels()'
             )
+        # distlint: disable=lock-discipline -- unlabeled families write {(): child} once in __init__ and never mutate again (labels() guards the mutating path); locking here would put a second lock acquisition on every inc/observe in the serving loop
         return self._children[()]
 
     def children(self) -> list[tuple[tuple[str, ...], object]]:
@@ -346,7 +347,7 @@ class MetricsRegistry:
     """Named collection of instruments with text exposition."""
 
     def __init__(self) -> None:
-        self._metrics: dict[str, _Metric] = {}
+        self._metrics: dict[str, _Metric] = {}  # guarded by self._lock
         self._lock = threading.Lock()
 
     def _get_or_create(self, cls, name, help, labelnames, **kwargs):

@@ -60,10 +60,10 @@ class ProfilerCapture:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._active: dict | None = None
-        self._timer: threading.Timer | None = None
-        self._last_error: str | None = None
-        self._captures = 0
+        self._active: dict | None = None  # guarded by self._lock
+        self._timer: threading.Timer | None = None  # guarded by self._lock
+        self._last_error: str | None = None  # guarded by self._lock
+        self._captures = 0  # guarded by self._lock
 
     def start(self, log_dir, max_seconds: float = 60.0) -> bool:
         """Begin a capture into ``log_dir``; returns whether it started.

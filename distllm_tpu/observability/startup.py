@@ -68,9 +68,9 @@ class CompileWatcher:
     def __init__(self, recorder: FlightRecorder | None = None) -> None:
         self._recorder = recorder
         self._lock = threading.Lock()
-        self._seen: set[tuple[str, str, str]] = set()
-        self._phases: list[dict] = []
-        self._active: dict | None = None
+        self._seen: set[tuple[str, str, str]] = set()  # guarded by self._lock
+        self._phases: list[dict] = []  # guarded by self._lock
+        self._active: dict | None = None  # guarded by self._lock
         self._scopes = itertools.count()
 
     def new_scope(self, prefix: str = 'engine') -> str:

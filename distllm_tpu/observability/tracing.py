@@ -38,7 +38,11 @@ _local = threading.local()
 # and the response the client got it echoed in. A ContextVar (not a plain
 # thread-local): the binding must survive explicit Context.run handoffs
 # while staying isolated between concurrently served requests.
-_request_id = contextvars.ContextVar('distllm-request-id', default=None)
+# The natural identifier spelling below once had to be 'distllm-request-id'
+# purely to dodge the legacy metric-name lint, which scanned every string in
+# the package; the distlint rule is scoped to registration/exposition
+# contexts, so non-metric identifiers no longer dictate naming.
+_request_id = contextvars.ContextVar('distllm_request_id', default=None)
 
 
 def current_request_id() -> str | None:
@@ -124,9 +128,9 @@ class TraceBuffer:
         if capacity < 1:
             raise ValueError('capacity must be >= 1')
         self.capacity = capacity
-        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._spans: deque[Span] = deque(maxlen=capacity)  # guarded by self._lock
         self._lock = threading.Lock()
-        self._recorded = 0
+        self._recorded = 0  # guarded by self._lock
 
     def record(self, span: Span) -> None:
         with self._lock:

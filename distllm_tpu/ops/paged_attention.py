@@ -117,7 +117,7 @@ def resolve_attn_backend(
     return 'pallas' if eligible else 'xla'
 
 
-def paged_attention_xla(
+def paged_attention_xla(  # distlint: traced
     q: jnp.ndarray,  # [B, num_heads, head_dim]
     k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
     v_cache: jnp.ndarray,
@@ -170,7 +170,7 @@ def paged_attention_xla(
     return out.reshape(b, num_heads, head_dim).astype(q.dtype)
 
 
-def ragged_paged_attention_xla(
+def ragged_paged_attention_xla(  # distlint: traced
     q: jnp.ndarray,  # [B, S, num_heads, head_dim] per-row query spans
     k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
     v_cache: jnp.ndarray,
@@ -255,7 +255,7 @@ def ragged_paged_attention_xla(
     return out.reshape(b, s, num_heads, head_dim).astype(q.dtype)
 
 
-def paged_prefill_attention_xla(
+def paged_prefill_attention_xla(  # distlint: traced
     q: jnp.ndarray,  # [B, S, num_heads, head_dim] tail queries
     k_cache: jnp.ndarray,  # [num_blocks, block_size, num_kv_heads, head_dim]
     v_cache: jnp.ndarray,
@@ -784,7 +784,7 @@ def paged_attention_pallas(
     )[:, 0]
 
 
-def write_token_kv(
+def write_token_kv(  # distlint: traced
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     new_k: jnp.ndarray,  # [B, num_kv_heads, head_dim]
@@ -802,7 +802,7 @@ def write_token_kv(
     return k_cache, v_cache
 
 
-def write_chunk_kv(
+def write_chunk_kv(  # distlint: traced
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     new_k: jnp.ndarray,  # [B, S, num_kv_heads, head_dim] tail K
@@ -840,7 +840,7 @@ def write_chunk_kv(
     return k_cache, v_cache
 
 
-def write_prefill_kv(
+def write_prefill_kv(  # distlint: traced
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     k_seq: jnp.ndarray,  # [S, num_kv_heads, head_dim] one sequence's K

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_tokens(
+def sample_tokens(  # distlint: traced
     logits: jnp.ndarray,  # [B, V] fp32
     key: jax.Array,
     temperature: jnp.ndarray,  # [B]
@@ -74,7 +74,7 @@ def sample_tokens(
     )
 
 
-def sample_tokens_windowed(
+def sample_tokens_windowed(  # distlint: traced
     logits: jnp.ndarray,
     key: jax.Array,
     temperature: jnp.ndarray,

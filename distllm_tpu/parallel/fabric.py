@@ -197,10 +197,13 @@ class Coordinator:
 class FabricWorker:
     """Worker loop: announce READY, execute tasks, reply, heartbeat always.
 
-    The heartbeat runs on a background thread (ZMQ sockets are not
-    thread-safe, so all sends share a lock) and keeps flowing while the main
-    thread is blocked inside a long task — the coordinator therefore only
-    reaps on real network/process loss.
+    Heartbeats flow in both phases (ZMQ sockets are not thread-safe, so
+    all socket use shares one unfair lock): while a task executes the
+    background thread sends them (the run loop is busy and the lock is
+    free), and while idle the poll loop sends them itself under the lock
+    it already holds (the tight poll cycle could otherwise starve the
+    thread out of the lock indefinitely) — the coordinator therefore
+    only reaps on real network/process loss.
 
     ``idle_timeout`` bounds how long the worker survives without hearing
     ANYTHING from the coordinator (which acks heartbeats while pumping).
@@ -220,7 +223,7 @@ class FabricWorker:
         import zmq
 
         self._ctx = zmq.Context.instance()
-        self._socket = self._ctx.socket(zmq.DEALER)
+        self._socket = self._ctx.socket(zmq.DEALER)  # guarded by self._send_lock
         self._socket.connect(coordinator)
         self.heartbeat_interval = heartbeat_interval
         self.idle_timeout = idle_timeout
@@ -231,6 +234,14 @@ class FabricWorker:
         with self._send_lock:
             self._socket.send_multipart(frames)
 
+    def _recv(self) -> list[bytes]:
+        """Receive under the socket lock: zmq sockets are not thread-safe,
+        and the heartbeat thread's sends would otherwise interleave with
+        the run loop's receives on the same DEALER socket. The poller has
+        already reported POLLIN, so the locked recv never blocks."""
+        with self._send_lock:
+            return self._socket.recv_multipart()
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
             self._send([_HEARTBEAT])
@@ -239,15 +250,35 @@ class FabricWorker:
     def run(self) -> None:
         import zmq
 
+        # Register BEFORE the heartbeat thread exists (no concurrent
+        # socket use yet), and keep a local handle for the poll-result
+        # membership test so the loop never touches the guarded slot.
+        poller = zmq.Poller()
+        with self._send_lock:
+            sock = self._socket
+            poller.register(sock, zmq.POLLIN)
         hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         hb_thread.start()
-        poller = zmq.Poller()
-        poller.register(self._socket, zmq.POLLIN)
         self._send([_READY])
         last_contact = time.monotonic()
+        last_heartbeat = time.monotonic()
         while not self._stop.is_set():
-            events = dict(poller.poll(timeout=500))
-            if self._socket not in events:
+            # Polling reads the shared socket's event state, so it holds
+            # the socket lock too — the socket is only ever touched by
+            # one thread at a time. threading.Lock is NOT fair: an idle
+            # loop re-acquires microseconds after each release, so the
+            # heartbeat thread could starve for the whole idle phase —
+            # the poll loop therefore sends the idle-phase heartbeats
+            # itself, under the lock it already holds. The thread covers
+            # the in-task phase, where the lock sits free.
+            with self._send_lock:
+                events = dict(poller.poll(timeout=500))
+                now = time.monotonic()
+                if now - last_heartbeat >= self.heartbeat_interval:
+                    self._socket.send_multipart([_HEARTBEAT])
+                    instruments.WORKER_HEARTBEATS.inc()
+                    last_heartbeat = now
+            if sock not in events:
                 if time.monotonic() - last_contact > self.idle_timeout:
                     log_event(
                         f'[worker] no coordinator contact for '
@@ -257,7 +288,7 @@ class FabricWorker:
                     break
                 continue
             last_contact = time.monotonic()
-            task_id, payload = self._socket.recv_multipart()
+            task_id, payload = self._recv()
             if not task_id:
                 if payload == _SHUTDOWN:
                     break

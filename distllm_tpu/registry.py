@@ -72,7 +72,7 @@ class WarmstartRegistry:
 
     def __init__(self, max_slots: int = 2) -> None:
         self._lock = threading.RLock()
-        self._slots: dict[str, _Entry] = {}
+        self._slots: dict[str, _Entry] = {}  # guarded by self._lock
         self._max_slots = max_slots
 
     def get(

@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""distlint — static analysis for distllm-tpu serving invariants.
+
+Thin wrapper so the analyzer runs without an installed package::
+
+    python scripts/distlint.py            # text findings, exit 1 if any
+    python scripts/distlint.py --json     # stable JSON report
+    python scripts/distlint.py --list-rules
+
+The implementation lives in ``distllm_tpu/analysis/`` (see
+``docs/static_analysis.md``); tier-1 enforces the same rules via
+``tests/test_lint.py``.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from distllm_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,18 +1,18 @@
-"""Lint/type gate (reference rigor parity: tox runs ruff strict + mypy
-strict, ``/root/reference`` tox.ini:1-15 — cited for provenance only).
+"""Tier-1 lint gate: a thin bridge onto the distlint framework.
 
-Layered so something always enforces:
+The rules themselves live in ``distllm_tpu/analysis/`` (see
+``docs/static_analysis.md``); this module's job is to keep tier-1
+enforcing every one of them. The whole surface is parsed ONCE
+(module-scoped project + one ``analyze`` pass feeding all rules — the
+legacy version re-parsed the tree per rule, ~8×), then each rule gets
+its own test function so a failure names the rule immediately.
 
-- ruff / mypy run when installed (``pip install -e .[lint]``; this image
-  ships neither and has no egress), configured in pyproject.toml;
-- an AST gate with zero dependencies runs everywhere: every source file
-  must parse, and no module may carry unused imports (the most common
-  rot this repo can accumulate; ruff F401 equivalent).
+ruff / mypy still run when installed (``pip install -e .[lint]``; this
+image ships neither and has no egress), configured in pyproject.toml.
 """
 
 from __future__ import annotations
 
-import ast
 import shutil
 import subprocess
 import sys
@@ -20,362 +20,81 @@ from pathlib import Path
 
 import pytest
 
+from distllm_tpu.analysis import (
+    META_RULE_IDS,
+    RULES,
+    analyze,
+    iter_rules,
+    load_project,
+)
+from distllm_tpu.analysis.core import SYNTAX_ERROR
+
 REPO = Path(__file__).resolve().parent.parent
-SOURCES = sorted(
-    list((REPO / 'distllm_tpu').rglob('*.py'))
-    + list((REPO / 'scripts').glob('*.py'))
-    + list((REPO / 'tests').glob('*.py'))
-    + [REPO / 'bench.py', REPO / '__graft_entry__.py']
+
+# All eleven registered rules, enforced in tier-1. Pinned by id so a rule
+# silently falling out of the registry fails here instead of passing
+# vacuously.
+EXPECTED_RULES = frozenset(
+    {
+        'unused-import',
+        'raw-print',
+        'direct-free',
+        'metric-name-catalog',
+        'flight-kind-catalog',
+        'trace-category-catalog',
+        'compile-phase-catalog',
+        'host-sync-in-hot-path',
+        'traced-python-branch',
+        'lock-discipline',
+        'nondeterminism-in-dispatch',
+    }
 )
 
 
-def test_everything_parses():
-    for path in SOURCES:
-        ast.parse(path.read_text(), filename=str(path))
-
-
-def _imported_names(tree: ast.AST):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                name = alias.asname or alias.name.split('.')[0]
-                yield node.lineno, name
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == '__future__':
-                continue
-            for alias in node.names:
-                if alias.name == '*':
-                    continue
-                yield node.lineno, alias.asname or alias.name
-
-
-def _used_names(tree: ast.AST) -> set[str]:
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            inner = node
-            while isinstance(inner, ast.Attribute):
-                inner = inner.value
-            if isinstance(inner, ast.Name):
-                used.add(inner.id)
-    # Names re-exported via __all__ strings count as used.
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name) and tgt.id == '__all__':
-                    for el in getattr(node.value, 'elts', []):
-                        if isinstance(el, ast.Constant):
-                            used.add(str(el.value))
-    return used
-
-
-def test_no_unused_imports():
-    offenders = []
-    for path in SOURCES:
-        if path.name == '__init__.py':
-            continue  # package surface re-exports by design
-        text = path.read_text()
-        lines = text.splitlines()
-        tree = ast.parse(text, filename=str(path))
-        used = _used_names(tree)
-        for lineno, name in _imported_names(tree):
-            if name in used:
-                continue
-            line = lines[lineno - 1]
-            # Only an F401 (or blanket) noqa exempts an unused import; a
-            # noqa for an unrelated rule (e.g. E402) must not mask rot.
-            if 'noqa: F401' in line or line.rstrip().endswith('# noqa'):
-                continue  # deliberate side-effect import
-            offenders.append(f'{path.relative_to(REPO)}:{lineno} {name}')
-    assert not offenders, 'unused imports:\n' + '\n'.join(offenders)
-
-
-def test_no_raw_print_telemetry():
-    """Telemetry goes through ``observability.log_event`` (counted, greppable),
-    not bare ``print(`` — which bypasses the metrics registry and is invisible
-    to scrapes. Only ``timer.py`` (the legacy ``[timer]`` line emitter) and
-    the ``observability`` package itself may print."""
-    package = REPO / 'distllm_tpu'
-    offenders = []
-    for path in sorted(package.rglob('*.py')):
-        relative = path.relative_to(package)
-        if relative.name == 'timer.py' or relative.parts[0] == 'observability':
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == 'print'
-            ):
-                offenders.append(f'{path.relative_to(REPO)}:{node.lineno}')
-    assert not offenders, (
-        'raw print( telemetry (use distllm_tpu.observability.log_event):\n'
-        + '\n'.join(offenders)
-    )
-
-
-def test_no_direct_block_free_outside_allocator_modules():
-    """KV blocks are freed ONLY by the allocator/scheduler/prefix-cache
-    machinery (``generate/engine/kv_cache.py`` + the scheduler bindings).
-    A stray ``allocator.free(...)`` anywhere else can double-free a block
-    that the prefix cache still maps — corruption that surfaces as another
-    request's KV, long after the bad call. The AST gate forbids any
-    ``X.free(...)`` attribute call in ``distllm_tpu`` outside those two
-    modules (same spirit as the raw-print rule: the dangerous spelling is
-    banned, the sanctioned paths are allowlisted)."""
-    package = REPO / 'distllm_tpu'
-    allowed = {
-        ('generate', 'engine', 'kv_cache.py'),
-        ('generate', 'engine', 'scheduler.py'),
+@pytest.fixture(scope='module')
+def findings() -> dict[str, list]:
+    """One parse of the lint surface, one pass of every rule, shared by
+    every test below — grouped by rule id (meta rules included)."""
+    project = load_project(REPO)
+    grouped: dict[str, list] = {
+        rule_id: [] for rule_id in (*RULES, *META_RULE_IDS)
     }
-    offenders = []
-    for path in sorted(package.rglob('*.py')):
-        if path.relative_to(package).parts in allowed:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == 'free'
-            ):
-                offenders.append(f'{path.relative_to(REPO)}:{node.lineno}')
-    assert not offenders, (
-        'direct .free( calls outside the allocator/cache modules '
-        '(route block lifecycle through the scheduler/PrefixCache):\n'
-        + '\n'.join(offenders)
+    for diag in analyze(project, iter_rules()):
+        grouped.setdefault(diag.rule_id, []).append(diag)
+    return grouped
+
+
+def _assert_clean(findings, rule_id: str) -> None:
+    diags = findings[rule_id]
+    assert not diags, (
+        f'[{rule_id}] findings (see docs/static_analysis.md; suppress '
+        'only with a justified "# distlint: disable=..." directive):\n'
+        + '\n'.join(d.format() for d in diags)
     )
 
 
-def _catalog_registered_names() -> set[str]:
-    """Metric names registered in the instruments.py catalog: the first
-    string argument of every ``*.counter/gauge/histogram(...)`` call."""
-    tree = ast.parse(
-        (REPO / 'distllm_tpu' / 'observability' / 'instruments.py').read_text()
-    )
-    names: set[str] = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in ('counter', 'gauge', 'histogram')
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-        ):
-            names.add(node.args[0].value)
-    return names
-
-
-def test_metric_names_registered_in_catalog():
-    """Every ``distllm_*`` metric name referenced anywhere in the package
-    (string literals — code AND docstrings) must be registered in the
-    ``instruments.py`` catalog. Prevents silent series drift: a typo'd or
-    ad-hoc ``registry.counter('distllm_...')`` at a call site would create
-    a series the catalog (and docs/observability.md, and the
-    first-scrape-full-schema guarantee) knows nothing about.
-
-    Histogram references may use the exposition suffixes ``_bucket`` /
-    ``_sum`` / ``_count`` of a registered base name.
-    """
-    import re
-
-    registered = _catalog_registered_names()
-    assert registered, 'catalog parse came back empty — rule is broken'
-    # Full-literal matches only; 'distllm_tpu*' is the package itself, and
-    # globs like 'distllm_prefix_cache_*' never match the name regex.
-    name_re = re.compile(r'^distllm_[a-z0-9_]+$')
-    suffix_re = re.compile(r'_(bucket|sum|count)$')
-    offenders = []
-    for path in sorted((REPO / 'distllm_tpu').rglob('*.py')):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not (
-                isinstance(node, ast.Constant)
-                and isinstance(node.value, str)
-            ):
-                continue
-            for word in re.findall(r'[A-Za-z0-9_]+', node.value):
-                if (
-                    not name_re.match(word)
-                    or word.startswith('distllm_tpu')
-                    or word.endswith('_')  # doc glob like distllm_foo_*
-                ):
-                    continue
-                base = suffix_re.sub('', word)
-                if word not in registered and base not in registered:
-                    offenders.append(
-                        f'{path.relative_to(REPO)}:{node.lineno} {word}'
-                    )
-    assert not offenders, (
-        'distllm_* metric names not registered in the instruments.py '
-        'catalog (add them there — the catalog is the series contract):\n'
-        + '\n'.join(sorted(set(offenders)))
+def test_registry_complete():
+    assert EXPECTED_RULES == set(RULES), (
+        'registered distlint rules drifted from the tier-1 contract'
     )
 
 
-def _frozenset_catalog(name: str) -> set[str]:
-    """String members of a ``NAME = frozenset({...})`` catalog in
-    ``instruments.py`` (AST-extracted, mirroring the metric-name catalog
-    parser)."""
-    tree = ast.parse(
-        (REPO / 'distllm_tpu' / 'observability' / 'instruments.py').read_text()
-    )
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        for tgt in node.targets:
-            if not (isinstance(tgt, ast.Name) and tgt.id == name):
-                continue
-            call = node.value  # frozenset({...})
-            if isinstance(call, ast.Call) and call.args:
-                return {
-                    el.value
-                    for el in getattr(call.args[0], 'elts', [])
-                    if isinstance(el, ast.Constant)
-                    and isinstance(el.value, str)
-                }
-    return set()
+def test_everything_parses(findings):
+    _assert_clean(findings, SYNTAX_ERROR)
 
 
-def _flight_kind_catalog() -> set[str]:
-    return _frozenset_catalog('FLIGHT_KINDS')
+@pytest.mark.parametrize('rule_id', sorted(EXPECTED_RULES))
+def test_rule_clean(findings, rule_id):
+    _assert_clean(findings, rule_id)
 
 
-def test_flight_record_kinds_registered_in_catalog():
-    """Every FlightRecorder ``kind`` emitted in the package (a string
-    literal — or a conditional between string literals — as the first
-    argument of a ``.record(...)`` / ``_record_step(...)`` call) must be
-    registered in the ``instruments.FLIGHT_KINDS`` catalog, mirroring the
-    ``distllm_*`` metric-name rule. A kind minted at a call site would
-    silently fragment the flight schema that debug bundles,
-    ``/debug/flight``, and ``aggregate.py`` replay."""
-    registered = _flight_kind_catalog()
-    assert registered, 'FLIGHT_KINDS parse came back empty — rule is broken'
-    offenders = []
-    for path in sorted((REPO / 'distllm_tpu').rglob('*.py')):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and node.args):
-                continue
-            func = node.func
-            name = (
-                func.attr if isinstance(func, ast.Attribute)
-                else func.id if isinstance(func, ast.Name)
-                else None
-            )
-            if name not in ('record', '_record_step'):
-                continue
-            first = node.args[0]
-            branches = (
-                (first.body, first.orelse)
-                if isinstance(first, ast.IfExp)
-                else (first,)
-            )
-            for branch in branches:
-                if not (
-                    isinstance(branch, ast.Constant)
-                    and isinstance(branch.value, str)
-                ):
-                    continue
-                if branch.value not in registered:
-                    offenders.append(
-                        f'{path.relative_to(REPO)}:{node.lineno} '
-                        f'{branch.value}'
-                    )
-    assert not offenders, (
-        'flight-record kinds not registered in instruments.FLIGHT_KINDS '
-        '(add them there — the catalog is the flight-schema contract):\n'
-        + '\n'.join(sorted(set(offenders)))
-    )
-
-
-def test_trace_event_categories_registered_in_catalog():
-    """Every trace-event category the package emits (a string literal
-    passed as a ``cat=...`` keyword or a ``'cat': ...`` dict key) must be
-    registered in ``instruments.TRACE_EVENT_CATEGORIES``, mirroring the
-    metric-name and flight-kind rules: a category minted at a call site
-    would fragment the trace schema Perfetto queries, the exporter
-    validator, and downstream tooling filter on."""
-    registered = _frozenset_catalog('TRACE_EVENT_CATEGORIES')
-    assert registered, (
-        'TRACE_EVENT_CATEGORIES parse came back empty — rule is broken'
-    )
-    offenders = []
-    for path in sorted((REPO / 'distllm_tpu').rglob('*.py')):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        emitted: list[tuple[int, str]] = []
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call):
-                for kw in node.keywords:
-                    if (
-                        kw.arg == 'cat'
-                        and isinstance(kw.value, ast.Constant)
-                        and isinstance(kw.value.value, str)
-                    ):
-                        emitted.append((node.lineno, kw.value.value))
-            elif isinstance(node, ast.Dict):
-                for key, value in zip(node.keys, node.values):
-                    if (
-                        isinstance(key, ast.Constant)
-                        and key.value == 'cat'
-                        and isinstance(value, ast.Constant)
-                        and isinstance(value.value, str)
-                    ):
-                        emitted.append((node.lineno, value.value))
-        for lineno, cat in emitted:
-            if cat not in registered:
-                offenders.append(
-                    f'{path.relative_to(REPO)}:{lineno} {cat}'
-                )
-    assert not offenders, (
-        'trace-event categories not registered in '
-        'instruments.TRACE_EVENT_CATEGORIES (add them there — the '
-        'catalog is the trace-schema contract):\n'
-        + '\n'.join(sorted(set(offenders)))
-    )
-
-
-def test_compile_phase_kinds_registered_in_catalog():
-    """Every startup/compile phase the package opens (a string literal as
-    the first argument of a ``.phase(...)`` call —
-    ``CompileWatcher.phase``) must be registered in
-    ``instruments.COMPILE_PHASES``, mirroring the metric-name /
-    flight-kind / trace-category rules: a phase minted at a call site
-    would fragment the startup schema that debug bundles and the
-    Perfetto startup track replay."""
-    registered = _frozenset_catalog('COMPILE_PHASES')
-    assert registered, (
-        'COMPILE_PHASES parse came back empty — rule is broken'
-    )
-    offenders = []
-    for path in sorted((REPO / 'distllm_tpu').rglob('*.py')):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and node.args):
-                continue
-            func = node.func
-            if not (
-                isinstance(func, ast.Attribute) and func.attr == 'phase'
-            ):
-                continue
-            first = node.args[0]
-            if (
-                isinstance(first, ast.Constant)
-                and isinstance(first.value, str)
-                and first.value not in registered
-            ):
-                offenders.append(
-                    f'{path.relative_to(REPO)}:{node.lineno} {first.value}'
-                )
-    assert not offenders, (
-        'compile-phase kinds not registered in instruments.COMPILE_PHASES '
-        '(add them there — the catalog is the startup-schema contract):\n'
-        + '\n'.join(sorted(set(offenders)))
-    )
+@pytest.mark.parametrize(
+    'meta_id', [m for m in META_RULE_IDS if m != SYNTAX_ERROR]
+)
+def test_suppressions_audited(findings, meta_id):
+    """Every suppression carries a justification, names a real rule, and
+    actually matches a finding (the audit trail cannot rot)."""
+    _assert_clean(findings, meta_id)
 
 
 @pytest.mark.skipif(shutil.which('ruff') is None, reason='ruff not installed')

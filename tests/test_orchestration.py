@@ -88,6 +88,36 @@ def test_zmq_fabric_propagates_errors():
         coordinator.close()
 
 
+def test_idle_worker_heartbeats_survive_starved_heartbeat_thread():
+    """The idle poll loop holds the (unfair) socket lock nearly 100% of
+    the time, so the heartbeat thread can starve — heartbeats must come
+    from the poll loop itself during the idle phase. Simulated worst
+    case: the heartbeat thread never sends at all."""
+    zmq = pytest.importorskip('zmq')
+    from distllm_tpu.parallel import fabric
+
+    router = zmq.Context.instance().socket(zmq.ROUTER)
+    port = router.bind_to_random_port('tcp://127.0.0.1')
+    worker = fabric.FabricWorker(
+        f'tcp://127.0.0.1:{port}', heartbeat_interval=0.1
+    )
+    worker._heartbeat_loop = lambda: None
+    thread = threading.Thread(target=worker.run, daemon=True)
+    thread.start()
+    try:
+        heartbeats = 0
+        deadline = time.monotonic() + 10
+        while heartbeats < 2 and time.monotonic() < deadline:
+            if router.poll(timeout=200):
+                frames = router.recv_multipart()
+                heartbeats += frames[-1] == fabric._HEARTBEAT
+        assert heartbeats >= 2, 'idle worker sent no heartbeats'
+    finally:
+        worker.stop()
+        thread.join(timeout=5)
+        router.close(linger=0)
+
+
 def _slow_task(x):
     import time
 
