@@ -32,10 +32,11 @@ record and a global wall-clock deadline:
   composed from whatever the run record holds — so an external kill still
   publishes every completed stage;
 - stages run cheapest-first (embed → embed_q → gen → gen_prefix →
-  gen_mixed → gen_spec → gen_kernel → gen_load → gen_q: embed warmups are
-  minutes, ``gen_prefix``/``gen_mixed``/``gen_spec``/``gen_load`` and
-  ``gen_kernel``'s XLA arm reuse ``gen``'s compile cache, and int8
-  ``gen_q``'s cold warmup — 22–45 min in round 4 — goes last);
+  gen_mixed → gen_spec → gen_kernel → gen_load → gen_tier → gen_q: embed
+  warmups are minutes, ``gen_prefix``/``gen_mixed``/``gen_spec``/
+  ``gen_load``/``gen_tier`` and ``gen_kernel``'s XLA arm reuse ``gen``'s
+  compile cache, and int8 ``gen_q``'s cold warmup — 22–45 min in round 4
+  — goes last);
 - a failing or SIGTERM'd stage dumps a debug bundle (flight ring, metrics,
   traces — ``observability.dump_debug_bundle``) so a dead stage still
   explains itself, and gen stages run under a ``StallWatchdog``.
@@ -1332,6 +1333,200 @@ def _stage_gen_load() -> dict:
     return out
 
 
+def _stage_gen_tier() -> dict:
+    """Host-RAM KV tier stage (docs/prefix_caching.md "Tier hierarchy"):
+    the loadgen's warm-session workload driven at a paged pool sized
+    BELOW the warm working set, so HBM-tier eviction is constant and the
+    warm prefixes only survive by spilling to the host tier.
+
+    Two arms over the identical workload:
+
+    - **tier on** (``host_kv_tier_bytes`` generous): evicted prefix
+      blocks spill device→host and promote back on re-arrival — records
+      warm-session TTFT, spill/promotion counts, and promotion overlap
+      efficiency (1 - blocking wait / promotion span);
+    - **tier off**: eviction drops KV, every warm repeat whose prefix
+      was evicted pays full prefill — the cold TTFT baseline.
+
+    The contract checked into the fragment: warm TTFT (tier on)
+    measurably below the tier-off cold TTFT, ≥1 recorded spill and ≥1
+    promotion, and tier on/off BIT-IDENTICAL tokens (greedy fp32 in the
+    smoke tier — promotion round-trips KV byte-exactly).
+    ``DISTLLM_BENCH_TIER=0`` skips the stage.
+    """
+    import jax
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.generate.loadgen import (
+        LoadgenConfig,
+        build_workload,
+        run_loadgen,
+    )
+    from distllm_tpu.models import mistral
+
+    prefix = 'gen_tier_'
+    if os.environ.get('DISTLLM_BENCH_TIER', '1') in ('', '0'):
+        return {f'{prefix}skipped': 'DISTLLM_BENCH_TIER=0'}
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        # fp32 so the tier on/off identity check is bit-exact across the
+        # two separately compiled arms (the acceptance contract); tiny
+        # dims keep the two warmups in the fast tier.
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='float32',
+        )
+        # 47 usable blocks vs a warm working set of 6 sessions x 9
+        # prefix blocks (54) + per-request tails + 3 running rows x ~11
+        # blocks: session prefixes cannot all stay resident, so warm
+        # re-arrivals must spill AND promote, by construction. The
+        # 144-token prefix keeps the promotion-vs-reprefill margin
+        # visible even at CPU-smoke model dims (a promoted block moves
+        # ~linear bytes; re-prefilling it pays the padded 256-bucket
+        # dense dispatch).
+        max_num_seqs, num_blocks, max_model_len, decode_steps = 3, 48, 256, 4
+        load_cfg = LoadgenConfig(
+            seed=0, num_requests=32, rate_rps=12.0, num_sessions=6,
+            warm_fraction=0.75, prefix_tokens=144, prompt_tokens=(8, 16),
+            output_tokens=(4, 10), vocab_size=model_cfg.vocab_size,
+            cache_blocks=num_blocks,
+        )
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+        # Pool ~1/2 the warm working set (16 sessions x 8 prefix blocks
+        # + 32 rows x ~24 blocks): chip-scale tier churn.
+        max_num_seqs, num_blocks, max_model_len, decode_steps = (
+            32, 640, 512, 16
+        )
+        load_cfg = LoadgenConfig(
+            seed=0, num_requests=192, rate_rps=16.0, num_sessions=16,
+            warm_fraction=0.65, prefix_tokens=128, prompt_tokens=(32, 160),
+            output_tokens=(16, 64), vocab_size=model_cfg.vocab_size,
+            cache_blocks=num_blocks,
+        )
+    workload = build_workload(load_cfg)
+    # Warm repeats: warm-session arrivals AFTER the session's first
+    # request — the requests whose TTFT the tier exists to shrink.
+    seen_sessions: set = set()
+    warm_repeat_idx: list[int] = []
+    for i, arrival in enumerate(workload):
+        if arrival.session is None:
+            continue
+        if arrival.session in seen_sessions:
+            warm_repeat_idx.append(i)
+        seen_sessions.add(arrival.session)
+
+    cache_before = _cache_entries()
+    warmup_total = 0.0
+    reports = {}
+    tier: dict = {}
+    fallback_reason = None
+    for arm, tier_bytes in (('on', 256 << 20), ('off', 0)):
+        engine_cfg = EngineConfig(
+            block_size=16,
+            num_blocks=load_cfg.cache_blocks or num_blocks,
+            max_num_seqs=max_num_seqs,
+            max_model_len=max_model_len,
+            decode_steps=decode_steps,
+            pipeline_depth=2,
+            sampling_top_window=64,
+            enable_prefix_cache=True,
+            host_kv_tier_bytes=tier_bytes,
+            attribution=True,
+        )
+        warmup_start = time.perf_counter()
+        engine, reason = _build_engine_with_fallback(
+            model_cfg,
+            engine_cfg,
+            lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+            [[1, 2, 3]],
+            SamplingParams(temperature=0.0, max_tokens=2),
+        )
+        warmup_total += time.perf_counter() - warmup_start
+        fallback_reason = fallback_reason or reason
+        try:
+            reports[arm] = run_loadgen(engine, workload)
+            if arm == 'on':
+                tier = engine.tier_summary()
+        finally:
+            # Each arm's weights + KV pool leave the chip before the next
+            # arm builds — two resident 7B engines would OOM HBM.
+            engine.shutdown()
+
+    on, off = reports['on'], reports['off']
+    identical = on.tokens_by_request == off.tokens_by_request
+
+    def _mean_ttft(report) -> float | None:
+        vals = [
+            report.ttft_by_request[i]
+            for i in warm_repeat_idx
+            if i < len(report.ttft_by_request)
+            and report.ttft_by_request[i] is not None
+        ]
+        return sum(vals) / len(vals) if vals else None
+
+    warm_ttft = _mean_ttft(on)
+    cold_ttft = _mean_ttft(off)
+    prompt_tokens = sum(len(a.prompt_ids) for a in workload)
+    out = {
+        f'{prefix}metric': 'warm-TTFT at cache sizes >> HBM (KV tier)',
+        f'{prefix}warm_ttft_s': round(warm_ttft, 6) if warm_ttft else None,
+        f'{prefix}cold_ttft_s': round(cold_ttft, 6) if cold_ttft else None,
+        f'{prefix}warm_ttft_speedup': (
+            round(cold_ttft / warm_ttft, 3)
+            if warm_ttft and cold_ttft else None
+        ),
+        f'{prefix}warm_repeats': len(warm_repeat_idx),
+        f'{prefix}tok_s': round(on.achieved_tok_s, 2),
+        f'{prefix}tier_off_tok_s': round(off.achieved_tok_s, 2),
+        f'{prefix}spills': tier.get('spills'),
+        f'{prefix}spilled_blocks': tier.get('spilled_blocks'),
+        f'{prefix}promotions': tier.get('promotions'),
+        f'{prefix}promoted_blocks': tier.get('promoted_blocks'),
+        f'{prefix}promotion_overlap': tier.get('promotion_overlap'),
+        f'{prefix}host_blocks': tier.get('host_blocks'),
+        f'{prefix}host_bytes': tier.get('host_bytes'),
+        f'{prefix}hit_rate': (
+            round(on.warm_prefix_hit_tokens / prompt_tokens, 4)
+            if prompt_tokens else None
+        ),
+        f'{prefix}tokens_identical': identical,
+        f'{prefix}pool_blocks': load_cfg.cache_blocks or num_blocks,
+        f'{prefix}warmup_secs': round(warmup_total, 1),
+        f'{prefix}device': str(jax.devices()[0].device_kind),
+        f'{prefix}workload': _workload_fingerprint(
+            {
+                'arrivals': [
+                    [a.at_s, list(a.prompt_ids), a.max_tokens, a.session]
+                    for a in workload
+                ],
+                'engine': {'max_num_seqs': max_num_seqs,
+                           'num_blocks': num_blocks,
+                           'decode_steps': decode_steps},
+            }
+        ),
+        **_cache_fields(prefix, cache_before),
+    }
+    if not identical:
+        out[f'{prefix}error'] = (
+            'tier on/off token mismatch — spill→promote round-trips must '
+            'be bit-exact against never-evicted KV'
+        )
+    elif not tier.get('spills') or not tier.get('promotions'):
+        out[f'{prefix}error'] = (
+            'no spill/promotion recorded — the pool is not below the '
+            'warm working set, the tier never engaged'
+        )
+    elif warm_ttft is None or cold_ttft is None or warm_ttft >= cold_ttft:
+        out[f'{prefix}error'] = (
+            f'warm TTFT {warm_ttft} not below tier-off cold TTFT '
+            f'{cold_ttft} — promotion is not beating re-prefill'
+        )
+    if fallback_reason:
+        out[f'{prefix}attn_fallback_reason'] = fallback_reason
+    return out
+
+
 def _stage_gen() -> dict:
     return _run_gen(None, 'gen_')
 
@@ -1370,7 +1565,7 @@ def _chip_peak_flops(device) -> float | None:
 # expensive coverage first, never the headline metrics.
 STAGE_ORDER = (
     'embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_spec',
-    'gen_kernel', 'gen_load', 'gen_q',
+    'gen_kernel', 'gen_load', 'gen_tier', 'gen_q',
 )
 NOMINAL_BUDGET_S = {
     'embed': 1200.0,
@@ -1381,11 +1576,12 @@ NOMINAL_BUDGET_S = {
     'gen_spec': 2700.0,
     'gen_kernel': 2700.0,
     'gen_load': 2700.0,
+    'gen_tier': 2700.0,
     'gen_q': 2700.0,
 }
 GEN_STAGES = frozenset(
     {'gen', 'gen_q', 'gen_prefix', 'gen_mixed', 'gen_spec', 'gen_kernel',
-     'gen_load'}
+     'gen_load', 'gen_tier'}
 )
 # Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
 # stages stop with ~5 min to spare even if the guess is exact, and the
@@ -1631,6 +1827,7 @@ def _run_stage_entry(stage: str) -> None:
         'gen_spec': _stage_gen_spec,
         'gen_kernel': _stage_gen_kernel,
         'gen_load': _stage_gen_load,
+        'gen_tier': _stage_gen_tier,
     }
     watchdog = None
     watchdog_s = float(os.environ.get('DISTLLM_BENCH_WATCHDOG_S', '300') or 0)
@@ -1655,7 +1852,7 @@ def main() -> None:
         '--stage',
         choices=[
             'embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix', 'gen_mixed',
-            'gen_spec', 'gen_kernel', 'gen_load',
+            'gen_spec', 'gen_kernel', 'gen_load', 'gen_tier',
         ],
     )
     args = parser.parse_args()

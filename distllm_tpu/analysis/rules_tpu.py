@@ -428,6 +428,17 @@ class HostSyncInHotPathRule(Rule):
             'LLMEngine._window_kmax',
             'LLMEngine._window_budget',
             'LLMEngine._reserve_shortfall',
+            # KV-tier spill/promotion (docs/prefix_caching.md "Tier
+            # hierarchy"): runs inside the serving loop under pool
+            # pressure. Exactly three designed syncs — the spill's K/V
+            # fetch pair and the promotion-completion probe — each with
+            # a justified suppression; anything else added here would
+            # re-serialize the async prefetch the tier exists for.
+            'LLMEngine._spill_blocks',
+            'LLMEngine._spill_chunk',
+            'LLMEngine._begin_promotion',
+            'LLMEngine._finish_promotions',
+            'LLMEngine._evict_cached_blocks',
         ),
         'distllm_tpu/models/mistral.py': (
             'mixed_window',

@@ -41,6 +41,8 @@ import numpy as np
 from pydantic import field_validator, model_validator
 
 from distllm_tpu.generate.engine.kv_cache import (
+    DiskKVTier,
+    HostKVTier,
     PagedKVCache,
     PrefixCache,
     block_digests,
@@ -105,6 +107,11 @@ class Request:
     # last prompt token must be recomputed into a private copy of it
     # (copy-on-write, resolved at prefill dispatch).
     cow_src_block: int | None = None
+    # --- host/disk KV tier (docs/prefix_caching.md "Tier hierarchy") ---
+    # Digests found in the host (or disk) tier past the HBM match at
+    # add_request: promoted back into the paged pool at admission via
+    # async device_put; cleared once the promotion begins.
+    promo_digests: list[bytes] = field(default_factory=list)
     # --- mixed serving windows (docs/serving.md) ---
     # Absolute token counts tracking a prefill tail riding decode windows:
     # target = tokens that must be prefilled (prompt + any recompute
@@ -202,7 +209,8 @@ class EngineConfig(BaseConfig):
 
     @field_validator(
         'sampling_top_window', 'prefill_chunk_tokens',
-        'max_window_prefill_tokens', 'draft_k',
+        'max_window_prefill_tokens', 'draft_k', 'host_kv_tier_bytes',
+        'disk_kv_tier_bytes',
     )
     @classmethod
     def _non_negative_window(cls, v: int, info) -> int:
@@ -269,6 +277,19 @@ class EngineConfig(BaseConfig):
                 '(the drafter needs host-side history), which removes '
                 "defer_prefill's in-flight deque (docs/speculative.md)"
             )
+        if self.host_kv_tier_bytes and not self.enable_prefix_cache:
+            raise ValueError(
+                'host_kv_tier_bytes needs enable_prefix_cache: the tier '
+                'spills and promotes PREFIX-CACHE blocks — without the '
+                'cache nothing ever reaches it (docs/prefix_caching.md)'
+            )
+        if self.disk_kv_tier_dir and not self.host_kv_tier_bytes:
+            raise ValueError(
+                'disk_kv_tier_dir needs host_kv_tier_bytes > 0: spills '
+                'reach disk by writing through the host tier, and '
+                'promotions route disk → host → device '
+                '(docs/prefix_caching.md "Tier hierarchy")'
+            )
         return self
     # Automatic prefix caching (docs/prefix_caching.md): full prompt
     # blocks enter a hash-chain cache as they prefill; later requests
@@ -277,6 +298,22 @@ class EngineConfig(BaseConfig):
     # — TTFT and prefill compute drop from O(prompt) to O(tail) for
     # prefix-heavy workloads (RAG system prompts, MCQA stems).
     enable_prefix_cache: bool = False
+    # Host-RAM KV tier behind the prefix cache (docs/prefix_caching.md
+    # "Tier hierarchy"): evicted ref==0 cache blocks spill device→host
+    # into a bounded digest-keyed pool instead of dropping their KV, and
+    # later same-prefix arrivals promote them back into the paged pool
+    # via async jax.device_put overlapped with in-flight decode windows
+    # — warm TTFT at prefix working sets far beyond HBM. Byte budget of
+    # the host pool (LRU); 0 disables the tier (HBM-only cache, the
+    # pre-tier behavior). Requires enable_prefix_cache.
+    host_kv_tier_bytes: int = 0
+    # Optional disk tier under the host pool: spills write THROUGH to
+    # one digest-named file per block in this directory, so a fresh
+    # engine serving the same corpus promotes straight from a previous
+    # process's spills (cold-start warm TTFT). None disables.
+    disk_kv_tier_dir: str | None = None
+    # Disk-tier byte budget (LRU; evictions there are final drops).
+    disk_kv_tier_bytes: int = 1 << 30
     # Split uncached prefill tails longer than this many tokens into
     # bucketed chunks dispatched sequentially (each chunk attends to the
     # KV already in the paged cache), so one long prompt cannot
@@ -613,6 +650,45 @@ class LLMEngine:
         self.prefix_cache = (
             PrefixCache(cfg.block_size) if cfg.enable_prefix_cache else None
         )
+        # Host-RAM (and disk) KV tier behind the prefix cache
+        # (docs/prefix_caching.md "Tier hierarchy"): eviction pressure
+        # cascades HBM → host → disk → drop, and host/disk hits promote
+        # back into the paged pool via async device_put at admission.
+        self.kv_tier = None
+        if cfg.host_kv_tier_bytes:
+            disk = (
+                DiskKVTier(cfg.disk_kv_tier_dir, cfg.disk_kv_tier_bytes)
+                if cfg.disk_kv_tier_dir
+                else None
+            )
+            self.kv_tier = HostKVTier(cfg.host_kv_tier_bytes, disk=disk)
+        # In-flight promotions: rid -> completion record ({'token': a tiny
+        # post-scatter device slice whose readiness proves the promoted
+        # KV landed, timing fields}). The request stays non-decode-ready
+        # (prefill_target gate) until _finish_promotions retires it.
+        self._promoting: dict[int, dict] = {}
+        # Promotion overlap accounting (tier_summary): span = begin →
+        # retire wall time, wait = the blocking part of that span (the
+        # one audited completion sync). overlap = 1 - wait/span.
+        self._tier_times = {'promote_wait_s': 0.0, 'promote_span_s': 0.0}
+        # Spill fetch (device→host gather of evicted blocks' KV) and
+        # promotion write-back (scatter of device_put'ed host KV).
+        # Block-count dims pad up a pow2 ladder so the jit cache stays
+        # O(log max_blocks_per_seq); pad slots index the trash block.
+        self._gather_blocks = jax.jit(
+            lambda k, v, idx: (k[:, idx], v[:, idx])
+        )
+        self._write_promoted = jax.jit(
+            lambda k, v, kp, vp, idx: (
+                k.at[:, idx].set(kp.astype(k.dtype)),
+                v.at[:, idx].set(vp.astype(v.dtype)),
+            ),
+            donate_argnums=(0, 1),
+        )
+        # Tiny post-scatter slice: fetching ONE element is the only
+        # reliable completion barrier on this backend (see _migrate
+        # _sync) — the promotion-landed probe.
+        self._probe = jax.jit(lambda a: jnp.ravel(a)[:1])
         _max_tables = cfg.max_model_len
 
         def prefill_paged_fn(params, ids, pos, k, v, bt, ctx, tails):
@@ -1135,6 +1211,37 @@ class LLMEngine:
                 self.kv.k, self.kv.v = self._cow_copy(
                     self.kv.k, self.kv.v, src_dev, dst_dev
                 )
+        if self.kv_tier is not None:
+            # Warm the tier's gather (spill fetch) / scatter (promotion
+            # write-back) pow2 block-count ladder. All indices are the
+            # trash block 0, so writes and reads touch no real state;
+            # without this the first pool-pressure spill would pay the
+            # compile inside the serving loop it interrupts.
+            num_layers, _, bs_, n_kv, head_dim = self.kv.shape
+            npad = 1
+            cap = self._pow2(self.max_blocks_per_seq)
+            while npad <= cap:
+                with watch.phase(
+                    'tier_promote', f'n{npad}', scope=self._compile_scope
+                ):
+                    idx = np.zeros((npad,), np.int32)
+                    zeros = np.zeros(
+                        (num_layers, npad, bs_, n_kv, head_dim),
+                        dtype=self.kv.dtype,
+                    )
+                    k_dev, v_dev, idx_dev = self._put_many(
+                        zeros, zeros, idx
+                    )
+                    self.kv.k, self.kv.v = self._write_promoted(
+                        self.kv.k, self.kv.v, k_dev, v_dev, idx_dev
+                    )
+                    gk, gv = self._gather_blocks(
+                        self.kv.k, self.kv.v, self._put(idx)
+                    )
+                    np.asarray(self._probe(self.kv.k))
+                    np.asarray(self._probe(gk))
+                    np.asarray(self._probe(gv))
+                npad *= 2
         bsz = self.config.max_num_seqs
         # Warm the fused decode window: steps_left = 0 freezes every slot,
         # so all KV writes land in the trash block and no state advances.
@@ -1484,6 +1591,31 @@ class LLMEngine:
                 cached_blocks = matched
                 request.num_cached_tokens = len(matched) * bs
             request.num_borrowed_blocks = len(cached_blocks)
+            if matched:
+                _metrics.PREFIX_TIER_HITS.labels(tier='hbm').inc(
+                    len(matched)
+                )
+            if self.kv_tier is not None and request.cow_src_block is None:
+                # Tier walk past the HBM hit: later digests still in the
+                # host/disk tier extend the cached prefix via promotion
+                # (begun at admission). Capped so at least one prompt
+                # token stays uncached — prefill needs a tail to produce
+                # last-token logits from (the HBM full-cover case routes
+                # through COW instead; a chain split by partial eviction
+                # stops the walk at the first block neither tier holds).
+                promo: list[bytes] = []
+                for digest in request.digests[len(cached_blocks):]:
+                    if self.kv_tier.lookup(digest) is None:
+                        break
+                    promo.append(digest)
+                while promo and (
+                    (len(cached_blocks) + len(promo)) * bs
+                    >= len(prompt_ids)
+                ):
+                    promo.pop()
+                request.promo_digests = promo
+            elif self.kv_tier is None and len(matched) < len(request.digests):
+                _metrics.PREFIX_TIER_MISSES.labels(tier='hbm').inc()
             _metrics.PREFIX_LOOKUP_TOKENS.inc(len(prompt_ids))
             if request.num_cached_tokens:
                 _metrics.PREFIX_HIT_TOKENS.inc(request.num_cached_tokens)
@@ -1513,7 +1645,14 @@ class LLMEngine:
         its request (stop token / max_tokens=1), freeing slots, so the
         admit→prefill cycle repeats until the scheduler yields nothing.
         """
-        emitted: list[tuple[int, int]] = []
+        # Retire landed tier promotions FIRST (non-blocking poll): their
+        # prefill tails are the oldest admitted work, and a promotion
+        # begun last cycle has had at least one decode window of
+        # transfer overlap by now.
+        emitted: list[tuple[int, int]] = list(
+            self._finish_promotions(defer_to, may_block=False)
+        )
+        admitted_any = False
         while True:
             admitted: list[Request] = []
             while (rid := self._admit_next_evicting()) is not None:
@@ -1526,7 +1665,22 @@ class LLMEngine:
                     )
                 admitted.append(request)
             if not admitted:
+                # Exit poll: promotions begun THIS call whose transfer
+                # already landed (is_ready — synchronous backends, or a
+                # transfer that raced ahead) prefill now instead of
+                # waiting a full loop cycle; in-flight ones keep
+                # overlapping with the windows the caller dispatches.
+                # Blocking is allowed only when this call admitted
+                # nothing — if it did, that freshly dispatched prefill
+                # work deserves its chance to overlap the transfer, and
+                # the next cycle's exit poll is the backstop.
+                emitted.extend(
+                    self._finish_promotions(
+                        defer_to, may_block=not admitted_any
+                    )
+                )
                 return emitted
+            admitted_any = True
             groups: dict[int, list[Request]] = {}
             paged: list[Request] = []
             chunk = self.config.prefill_chunk_tokens
@@ -1539,6 +1693,11 @@ class LLMEngine:
                 self.config.enable_mixed_batching and self._mixed_can_ride()
             )
             for request in admitted:
+                if request.promo_digests and self._begin_promotion(request):
+                    # Host-tier hit: the block transfer is in flight and
+                    # the request waits (non-decode-ready, no prefill)
+                    # until _finish_promotions retires it next cycle.
+                    continue
                 # Re-prefill covers generated tokens too (recompute
                 # preemption path) but never the cached prefix — tail-only
                 # prefill is the prefix cache's whole win.
@@ -1617,14 +1776,248 @@ class LLMEngine:
 
     def _evict_cached_blocks(self, shortfall: int) -> int:
         """Evict up to ``shortfall`` LRU cache blocks into the scheduler's
-        free list; returns how many were actually freed."""
+        free list; returns how many were actually freed. With the host KV
+        tier enabled the evicted blocks' KV is spilled device→host first
+        (eviction cascades HBM → host → disk → drop); without it the KV
+        is dropped outright — counted, never silent."""
         if self.prefix_cache is None or shortfall <= 0:
             return 0
-        freed = self.prefix_cache.evict(shortfall)
-        if freed:
-            self.sched.release_blocks(freed)
-            self._stats['prefix_evicted_blocks'] += len(freed)
+        entries = self.prefix_cache.evict_entries(shortfall)
+        if not entries:
+            return 0
+        if self.kv_tier is not None:
+            self._spill_blocks(entries)
+        else:
+            # HBM is the only tier: this eviction loses the KV for good.
+            _metrics.PREFIX_TIER_DROPPED_BLOCKS.inc(len(entries))
+        freed = [bid for _, bid in entries]
+        self.sched.release_blocks(freed)
+        self._stats['prefix_evicted_blocks'] += len(freed)
         return len(freed)
+
+    # ------------------------------------------------- host/disk KV tier
+    @staticmethod
+    def _pow2(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _spill_blocks(self, entries: list[tuple[bytes, int]]) -> None:
+        """Fetch the evicted blocks' KV device→host in padded gathers and
+        adopt them into the host tier, clamped per gather to the pow2
+        ladder :meth:`warmup` compiled — a multi-row reservation
+        shortfall can evict more blocks than max_blocks_per_seq, and an
+        unwarmed gather shape would stall the serving loop on a compile."""
+        cap = self._pow2(self.max_blocks_per_seq)
+        for start in range(0, len(entries), cap):
+            self._spill_chunk(entries[start : start + cap])
+
+    def _spill_chunk(self, entries: list[tuple[bytes, int]]) -> None:
+        """One padded device→host gather of ``entries``' KV — the spill
+        side's designed host sync: it runs only under pool pressure,
+        serializes against at most the in-flight windows, and its cost is
+        on the flight ring as the 'spill' record's fetch_s."""
+        t_start = time.monotonic()
+        n = len(entries)
+        npad = self._pow2(n)
+        idx = np.zeros((npad,), np.int32)
+        for i, (_, bid) in enumerate(entries):
+            idx[i] = bid
+        k_dev, v_dev = self._gather_blocks(
+            self.kv.k, self.kv.v, self._put(idx)
+        )
+        t_fetch = time.monotonic()
+        with self._annotate('fetch'):
+            # distlint: disable=host-sync-in-hot-path -- the spill tier's ONE designed fetch point: evicted ref==0 blocks must cross to host RAM before their pool blocks are reused, and eviction only fires on pool-pressure shortfalls
+            k_host = np.asarray(k_dev)
+            # distlint: disable=host-sync-in-hot-path -- second half of the same designed spill fetch (V plane of the one padded gather above)
+            v_host = np.asarray(v_dev)
+        fetch_s = time.monotonic() - t_fetch
+        for i, (digest, _) in enumerate(entries):
+            # Per-block copies: LRU eviction must free blocks one at a
+            # time, which views over the gathered base array cannot.
+            self.kv_tier.put(digest, k_host[:, i].copy(), v_host[:, i].copy())
+        self._stats['tier_spills'] += 1
+        self._stats['tier_spilled_blocks'] += n
+        self.flight.record(
+            'spill',
+            blocks=n,
+            bytes=int(k_host[:, :n].nbytes + v_host[:, :n].nbytes),
+            fetch_s=round(fetch_s, 6),
+            duration_s=round(time.monotonic() - t_start, 6),
+            host_tier_blocks=self.kv_tier.num_blocks,
+        )
+
+    def _begin_promotion(self, request: Request) -> bool:
+        """Start the async promotion of ``request``'s host-tier blocks
+        back into the paged pool: device_put the pooled KV, dispatch the
+        scatter into the request's own blocks, and ADOPT those blocks
+        into the prefix cache immediately (insert + lend_prefix), so they
+        are borrowed — counted toward budgets, never freed to the free
+        list mid-promotion, surviving preemption like any cached prefix.
+        No host sync here: the transfer overlaps in-flight decode windows
+        and ``_finish_promotions`` retires it next cycle. Returns False
+        when the tier entries vanished (evicted since add_request) — the
+        caller falls through to the normal prefill routing."""
+        digests = request.promo_digests
+        request.promo_digests = []
+        rid = request.request_id
+        bs = self.config.block_size
+        pulled: list[tuple[np.ndarray, np.ndarray]] = []
+        for digest in digests:
+            kv = self.kv_tier.get(digest)
+            if kv is None:
+                break  # tier-evicted since the add_request walk
+            pulled.append(kv)
+        if not pulled:
+            return False
+        t_start = time.monotonic()
+        n = len(pulled)
+        digests = digests[:n]
+        nb = request.num_borrowed_blocks
+        blocks = self.sched.block_row(rid)[nb : nb + n]
+        npad = self._pow2(n)
+        num_layers, _, block_size, n_kv, head_dim = self.kv.shape
+        k_host = np.zeros(
+            (num_layers, npad, block_size, n_kv, head_dim),
+            dtype=pulled[0][0].dtype,
+        )
+        v_host = np.zeros_like(k_host)
+        idx = np.zeros((npad,), np.int32)
+        for i, (k_b, v_b) in enumerate(pulled):
+            k_host[:, i] = k_b
+            v_host[:, i] = v_b
+            idx[i] = blocks[i]
+        t_host = time.monotonic()
+        k_dev, v_dev, idx_dev = self._put_many(k_host, v_host, idx)
+        with self._annotate('promote'):
+            self.kv.k, self.kv.v = self._write_promoted(
+                self.kv.k, self.kv.v, k_dev, v_dev, idx_dev
+            )
+        token = self._probe(self.kv.k)
+        t_dispatch = time.monotonic()
+        # Adopt NOW (not at completion): once inserted + lent the blocks
+        # are cache property in both scheduler front-ends — preemption
+        # keeps them and dispatch ordering guarantees every later reader
+        # sees the scattered KV. First-writer-wins may reject a digest a
+        # concurrent request prefilled meanwhile; blocks past the first
+        # rejection stay owned (their KV is still valid for THIS row).
+        lent = nb
+        for digest, bid in zip(digests, blocks):
+            if not self.prefix_cache.insert(rid, digest, bid):
+                break
+            lent += 1
+        if lent > nb:
+            self.sched.lend_prefix(rid, lent)
+            request.num_borrowed_blocks = lent
+        request.num_cached_tokens = (nb + n) * bs
+        # Decode-readiness gate (the mixed-window mechanism, reused): the
+        # request takes no decode steps and no prefill until the
+        # promotion retires and its tail prefills.
+        request.prefill_target = request.num_tokens
+        request.prefill_sent = request.num_cached_tokens
+        request.prefill_done = request.num_cached_tokens
+        self._promoting[rid] = {
+            'token': token,
+            'blocks': n,
+            'tokens': n * bs,
+            't_start': t_start,
+            'put_s': round(t_dispatch - t_host, 6),
+            'host_s': round(t_host - t_start, 6),
+        }
+        self._stats['tier_promotions'] += 1
+        self._stats['tier_promoted_blocks'] += n
+        _metrics.PREFIX_TIER_PROMOTIONS.labels(tier='host').inc(n)
+        _metrics.PREFIX_HIT_TOKENS.inc(n * bs)
+        self._stats['prefix_hit_tokens'] += n * bs
+        return True
+
+    def _finish_promotions(
+        self, defer_to=None, may_block: bool = True
+    ) -> list[tuple[int, int]]:
+        """Retire landed promotions: one audited completion sync per
+        promotion (visible as the 'promote' record's wait_s, the put_s
+        twin of the window fetch), then prefill the still-uncached tail
+        exactly as a plain cache hit would. Non-blocking while other rows
+        can make progress — the poll keeps the device_put overlapped with
+        decode windows; it hard-waits only when ``may_block`` (the
+        caller's admission round produced nothing to overlap with) AND
+        every running row is itself waiting on a promotion — the state
+        nothing else can advance out of."""
+        if not self._promoting:
+            return []
+        block = may_block and all(
+            rid in self._promoting for _, rid in self.sched.running()
+        )
+        ready: list[Request] = []
+        for rid in list(self._promoting):
+            record = self._promoting[rid]
+            request = self._requests.get(rid)
+            if request is None or request.state is not RequestState.RUNNING:
+                self._promoting.pop(rid)  # finished/preempted meanwhile
+                continue
+            token = record['token']
+            if not block:
+                is_ready = getattr(token, 'is_ready', None)
+                if is_ready is not None and not is_ready():
+                    continue  # still in flight; keep overlapping
+            t_wait = time.monotonic()
+            with self._annotate('fetch'):
+                # distlint: disable=host-sync-in-hot-path -- the promotion path's ONE designed completion sync: a one-element probe of the post-scatter pool proves the promoted KV landed before the tail prefill (and any decode window) reads it
+                np.asarray(token)
+            wait_s = time.monotonic() - t_wait
+            span_s = time.monotonic() - record['t_start']
+            self._tier_times['promote_wait_s'] += wait_s
+            self._tier_times['promote_span_s'] += span_s
+            self._promoting.pop(rid)
+            request.prefill_target = 0
+            request.prefill_sent = request.num_cached_tokens
+            request.prefill_done = request.num_cached_tokens
+            ready.append(request)
+            self.flight.record(
+                'promote',
+                rids=[rid],
+                blocks=record['blocks'],
+                tokens=record['tokens'],
+                host_s=record['host_s'],
+                put_s=record['put_s'],
+                wait_s=round(wait_s, 6),
+                span_s=round(span_s, 6),
+                overlap=round(max(0.0, 1.0 - wait_s / span_s), 4)
+                if span_s > 0 else None,
+            )
+        if not ready:
+            return []
+        return self._run_prefill_paged(ready, defer_to)
+
+    def tier_summary(self) -> dict:
+        """Host/disk KV-tier counters and promotion-overlap efficiency
+        (empty when the tier is disabled) — what the ``gen_tier`` bench
+        stage checkpoints next to warm/cold TTFT."""
+        if self.kv_tier is None:
+            return {}
+        wait = self._tier_times['promote_wait_s']
+        span = self._tier_times['promote_span_s']
+        out = {
+            'spills': int(self._stats.get('tier_spills', 0)),
+            'spilled_blocks': int(self._stats.get('tier_spilled_blocks', 0)),
+            'promotions': int(self._stats.get('tier_promotions', 0)),
+            'promoted_blocks': int(
+                self._stats.get('tier_promoted_blocks', 0)
+            ),
+            'promote_wait_s': round(wait, 6),
+            'promote_span_s': round(span, 6),
+            'promotion_overlap': (
+                round(max(0.0, 1.0 - wait / span), 4) if span > 0 else None
+            ),
+            'host_blocks': self.kv_tier.num_blocks,
+            'host_bytes': self.kv_tier.bytes_used,
+        }
+        if self.kv_tier.disk is not None:
+            out['disk_blocks'] = self.kv_tier.disk.num_blocks
+            out['disk_bytes'] = self.kv_tier.disk.bytes_used
+        return out
 
     def _prefill_batch_cap(self, bucket: int) -> int:
         """Largest pow2 batch for this bucket under the prefill caps.
@@ -2376,7 +2769,13 @@ class LLMEngine:
         k = self.config.decode_steps
         kmax = self._window_kmax()
         decode_rids = None
-        if self.config.enable_mixed_batching:
+        if self.config.enable_mixed_batching or self._promoting:
+            # Promotion-pending rows mirror mixed prefill rows: they take
+            # no decode steps this window and their blocks were budgeted
+            # at admission, so they must be excluded from the k-token
+            # guarantee — otherwise prepare_decode would allocate (and
+            # possibly preempt) for rows _reserve_shortfall skipped,
+            # breaking the pipelined drain-before-preempt invariant.
             decode_rids = [
                 rid for _, rid in self.sched.running()
                 if self._decode_ready(self._requests[rid])
@@ -2803,6 +3202,11 @@ class LLMEngine:
 
     def _on_preempt(self, request: Request) -> None:
         request.state = RequestState.WAITING
+        # A promotion in flight for the victim is simply dropped: its
+        # scatter is already dispatched (ordering protects later readers)
+        # and the blocks it adopted are borrowed — preemption keeps them,
+        # so re-admission resumes from the promoted coverage for free.
+        self._promoting.pop(request.request_id, None)
         if self.prefix_cache is not None:
             # Recompute preemption kept only the borrowed (cache-owned)
             # prefix; everything past it was freed and must re-prefill.
@@ -3131,6 +3535,10 @@ class LLMEngine:
             self.telemetry['spec_windows_per_s'] = round(
                 spec_windows / loop_s, 2
             )
+        if self.kv_tier is not None:
+            overlap = self.tier_summary().get('promotion_overlap')
+            if overlap is not None:
+                self.telemetry['tier_promotion_overlap'] = overlap
         if n_out:
             self.telemetry['overshoot_frac'] = round(
                 self._stats.get('overshoot_tokens', 0) / n_out, 4

@@ -16,6 +16,14 @@ eviction of unreferenced blocks. It owns the REUSE policy only — physical
 block accounting stays with the scheduler, which marks cache-held blocks
 as a request's "borrowed prefix" (``scheduler.py``).
 
+:class:`HostKVTier` and :class:`DiskKVTier` extend the cache past HBM
+(docs/prefix_caching.md "Tier hierarchy"): eviction cascades
+HBM → host-RAM → disk → drop instead of dropping KV at the first tier,
+and the engine promotes tier hits back into the paged pool via async
+``device_put`` overlapped with decode windows. Both tiers are pure host
+pools keyed by the same chained digests; the disk tier's digest-named
+files persist warm prefixes across engine restarts.
+
 Mixed serving windows (docs/serving.md) write prefill-chunk K/V inside
 decode dispatches; those writes always land in blocks the owning request
 was granted at admission (the full prompt is budgeted up front), so no
@@ -28,12 +36,17 @@ from __future__ import annotations
 
 import ctypes
 import hashlib
+import json
+import os
+import threading
 from collections import OrderedDict
+from pathlib import Path
 from dataclasses import dataclass, field
 from typing import Protocol, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class BlockAllocator(Protocol):
@@ -276,18 +289,29 @@ class PrefixCache:
     def evict(self, max_blocks: int) -> list[int]:
         """Pop up to ``max_blocks`` LRU evictable blocks; caller returns
         them to the scheduler free list."""
-        freed: list[int] = []
-        while self._evictable and len(freed) < max_blocks:
+        return [bid for _, bid in self.evict_entries(max_blocks)]
+
+    def evict_entries(self, max_blocks: int) -> list[tuple[bytes, int]]:
+        """``evict`` but returning ``(digest, block_id)`` pairs, so the
+        engine can spill the evicted blocks' KV into the host tier
+        (``HostKVTier``) before the blocks rejoin the free list. Eviction
+        is never silent: every popped block counts into the per-tier
+        eviction series (``distllm_prefix_tier_evictions_total{tier=hbm}``)
+        whether or not a lower tier catches it — the caller records the
+        final-drop counter when no tier exists."""
+        evicted: list[tuple[bytes, int]] = []
+        while self._evictable and len(evicted) < max_blocks:
             digest, block_id = self._evictable.popitem(last=False)
             del self._entries[digest]
-            freed.append(block_id)
-        if freed:
+            evicted.append((digest, block_id))
+        if evicted:
             from distllm_tpu.observability import instruments as _m
 
-            _m.PREFIX_EVICTIONS.inc(len(freed))
-        self.stats['evictions'] += len(freed)
+            _m.PREFIX_EVICTIONS.inc(len(evicted))
+            _m.PREFIX_TIER_EVICTIONS.labels(tier='hbm').inc(len(evicted))
+        self.stats['evictions'] += len(evicted)
         self._publish()
-        return freed
+        return evicted
 
     # -------------------------------------------------------------- state
     @property
@@ -308,6 +332,263 @@ class PrefixCache:
         _m.PREFIX_CACHED_BLOCKS.set(self.num_cached)
         _m.PREFIX_EVICTABLE_BLOCKS.set(self.num_evictable)
         _m.PREFIX_SHARED_BLOCKS.set(self.num_shared)
+
+
+class DiskKVTier:
+    """Digest-keyed KV block files: the persistence tier under the host
+    pool (docs/prefix_caching.md "Tier hierarchy").
+
+    One ``<digest-hex>.kvblock`` file per spilled block (a JSON header
+    line carrying shape/dtype, then the raw K bytes followed by the raw V
+    bytes — byte-exact for bf16 and every other KV dtype, no pickle).
+    The digest chain makes the file name self-describing: it identifies
+    the ENTIRE token prefix up to and including the block, so a fresh
+    engine on the same corpus promotes straight from a previous process's
+    spills (cold-start warm TTFT). Bounded by ``max_bytes`` with LRU on
+    use order; the on-disk index is rebuilt from file mtimes at
+    construction. Thread-safe: the engine loop and server threads may
+    race lookups against spills.
+    """
+
+    _SUFFIX = '.kvblock'
+
+    def __init__(self, root: str | os.PathLike, max_bytes: int) -> None:
+        self._lock = threading.Lock()
+        self._root = Path(root)
+        self._root.mkdir(parents=True, exist_ok=True)
+        self.max_bytes = int(max_bytes)
+        # hex digest -> file size, LRU order (oldest first), rebuilt from
+        # mtimes so restarts keep the eviction order roughly honest.
+        self._index: 'OrderedDict[str, int]' = OrderedDict()  # guarded by self._lock
+        self._bytes = 0  # guarded by self._lock
+        entries = []
+        for path in self._root.glob(f'*{self._SUFFIX}'):
+            try:
+                stat = path.stat()
+            except OSError:
+                continue
+            entries.append((stat.st_mtime, path.stem, stat.st_size))
+        for _, hexdigest, size in sorted(entries):
+            self._index[hexdigest] = size
+            self._bytes += size
+        self._evict_over_budget_locked()
+        self._publish_locked()
+
+    def _path(self, hexdigest: str) -> Path:
+        return self._root / f'{hexdigest}{self._SUFFIX}'
+
+    # Called with self._lock held by every mutating public method.
+    def _evict_over_budget_locked(self) -> int:  # guarded by self._lock
+        dropped = 0
+        while self._bytes > self.max_bytes and self._index:
+            hexdigest, size = self._index.popitem(last=False)
+            self._bytes -= size
+            try:
+                self._path(hexdigest).unlink()
+            except OSError:
+                pass
+            dropped += 1
+        if dropped:
+            from distllm_tpu.observability import instruments as _m
+
+            # Disk is the lowest tier: its evictions ARE final drops —
+            # the prefix must re-prefill on its next arrival.
+            _m.PREFIX_TIER_EVICTIONS.labels(tier='disk').inc(dropped)
+            _m.PREFIX_TIER_DROPPED_BLOCKS.inc(dropped)
+        return dropped
+
+    def _publish_locked(self) -> None:  # guarded by self._lock
+        from distllm_tpu.observability import instruments as _m
+
+        _m.PREFIX_TIER_BYTES.labels(tier='disk').set(self._bytes)
+
+    def contains(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest.hex() in self._index
+
+    def put(self, digest: bytes, k: np.ndarray, v: np.ndarray) -> bool:
+        """Persist one block's KV; False when already present (the file
+        contents are digest-determined, so rewriting buys nothing)."""
+        hexdigest = digest.hex()
+        header = json.dumps(
+            {'shape': list(k.shape), 'dtype': str(k.dtype)}
+        ).encode() + b'\n'
+        payload = header + k.tobytes() + v.tobytes()
+        with self._lock:
+            if hexdigest in self._index:
+                self._index.move_to_end(hexdigest)
+                return False
+            path = self._path(hexdigest)
+            tmp = path.with_suffix('.tmp')
+            try:
+                tmp.write_bytes(payload)
+                os.replace(tmp, path)
+            except OSError:
+                return False  # full/read-only disk degrades to no tier
+            self._index[hexdigest] = len(payload)
+            self._bytes += len(payload)
+            from distllm_tpu.observability import instruments as _m
+
+            _m.PREFIX_TIER_SPILLS.labels(tier='disk').inc()
+            self._evict_over_budget_locked()
+            self._publish_locked()
+        return True
+
+    def get(self, digest: bytes) -> tuple[np.ndarray, np.ndarray] | None:
+        """Load one block's (K, V) host arrays; refreshes its LRU slot.
+        The file read happens OUTSIDE the lock — contains() runs on the
+        admission path and must not stall behind multi-megabyte cold-disk
+        reads. A concurrent eviction racing the read is just a miss."""
+        hexdigest = digest.hex()
+        with self._lock:
+            if hexdigest not in self._index:
+                return None
+            self._index.move_to_end(hexdigest)
+        try:
+            payload = self._path(hexdigest).read_bytes()
+        except OSError:
+            with self._lock:
+                size = self._index.pop(hexdigest, None)
+                if size is not None:
+                    self._bytes -= size
+                    self._publish_locked()
+            return None
+        header, _, body = payload.partition(b'\n')
+        meta = json.loads(header)
+        # jnp.dtype resolves 'bfloat16' through ml_dtypes into a numpy-
+        # compatible dtype, so the round trip is byte-exact for bf16 KV.
+        dtype = np.dtype(jnp.dtype(meta['dtype']))
+        shape = tuple(meta['shape'])
+        half = len(body) // 2
+        k = np.frombuffer(body[:half], dtype=dtype).reshape(shape)
+        v = np.frombuffer(body[half:], dtype=dtype).reshape(shape)
+        return k, v
+
+    @property
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+
+class HostKVTier:
+    """Bounded digest-keyed host-RAM pool of spilled KV blocks — the tier
+    between the HBM prefix cache and the (optional) disk tier.
+
+    The engine spills evicted ref==0 cache blocks here (one device→host
+    fetch per eviction batch) instead of dropping their KV; a later
+    same-prefix arrival promotes them back into the paged pool via async
+    ``jax.device_put`` (engine ``_begin_promotion``). Entries are whole
+    per-block KV slices (``[L, block_size, N_kv, Hd]`` each for K and V)
+    keyed by the chained block digest, LRU-ordered, bounded by
+    ``max_bytes``. With a :class:`DiskKVTier` attached, spills write
+    THROUGH to disk (persistence never depends on host-LRU timing) and
+    host misses fall through to disk, pulling hits back into the host
+    pool. Thread-safe for the same reason as the disk tier.
+    """
+
+    def __init__(self, max_bytes: int, disk: DiskKVTier | None = None) -> None:
+        self._lock = threading.Lock()
+        self.max_bytes = int(max_bytes)
+        self.disk = disk
+        # digest -> (k, v) host arrays, LRU order (oldest first).
+        self._entries: 'OrderedDict[bytes, tuple[np.ndarray, np.ndarray]]' = (
+            OrderedDict()
+        )  # guarded by self._lock
+        self._bytes = 0  # guarded by self._lock
+
+    def _publish_locked(self) -> None:  # guarded by self._lock
+        from distllm_tpu.observability import instruments as _m
+
+        _m.PREFIX_TIER_BYTES.labels(tier='host').set(self._bytes)
+
+    def _evict_over_budget_locked(self) -> None:  # guarded by self._lock
+        from distllm_tpu.observability import instruments as _m
+
+        while self._bytes > self.max_bytes and self._entries:
+            digest, (k, v) = self._entries.popitem(last=False)
+            self._bytes -= k.nbytes + v.nbytes
+            _m.PREFIX_TIER_EVICTIONS.labels(tier='host').inc()
+            # Write-through at put() time normally persisted the block,
+            # but a full/read-only disk degrades put() to a no-op — so
+            # the drop decision checks what the disk actually HOLDS, not
+            # what was attempted. Lock order host→disk only (the disk
+            # tier never takes the host lock), so this cannot deadlock.
+            if self.disk is None or not self.disk.contains(digest):
+                _m.PREFIX_TIER_DROPPED_BLOCKS.inc()
+
+    def lookup(self, digest: bytes) -> str | None:
+        """Which tier holds ``digest`` (``'host'``/``'disk'``/None), with
+        hit/miss accounting. Pure membership — no load, no LRU touch —
+        so ``add_request``'s promotion-planning walk stays cheap."""
+        from distllm_tpu.observability import instruments as _m
+
+        with self._lock:
+            if digest in self._entries:
+                _m.PREFIX_TIER_HITS.labels(tier='host').inc()
+                return 'host'
+        if self.disk is not None and self.disk.contains(digest):
+            _m.PREFIX_TIER_HITS.labels(tier='disk').inc()
+            return 'disk'
+        _m.PREFIX_TIER_MISSES.labels(tier='disk' if self.disk else 'host').inc()
+        return None
+
+    def put(self, digest: bytes, k: np.ndarray, v: np.ndarray) -> bool:
+        """Adopt one spilled block (host copies of its K/V slices)."""
+        from distllm_tpu.observability import instruments as _m
+
+        if self.disk is not None:
+            self.disk.put(digest, k, v)
+        with self._lock:
+            if digest in self._entries:
+                self._entries.move_to_end(digest)
+                return False
+            self._entries[digest] = (k, v)
+            self._bytes += k.nbytes + v.nbytes
+            _m.PREFIX_TIER_SPILLS.labels(tier='host').inc()
+            self._evict_over_budget_locked()
+            self._publish_locked()
+        return True
+
+    def get(self, digest: bytes) -> tuple[np.ndarray, np.ndarray] | None:
+        """(K, V) for ``digest``, refreshing its LRU slot; host misses
+        fall through to the disk tier, and a disk hit re-enters the host
+        pool (a promoted prefix is about to be hot again)."""
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                self._entries.move_to_end(digest)
+                return entry
+        if self.disk is None:
+            return None
+        loaded = self.disk.get(digest)
+        if loaded is None:
+            return None
+        from distllm_tpu.observability import instruments as _m
+
+        _m.PREFIX_TIER_PROMOTIONS.labels(tier='disk').inc()
+        k, v = loaded
+        with self._lock:
+            if digest not in self._entries:
+                self._entries[digest] = (k, v)
+                self._bytes += k.nbytes + v.nbytes
+                self._evict_over_budget_locked()
+                self._publish_locked()
+        return k, v
+
+    @property
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
 
 
 def make_allocator(num_blocks: int, prefer_native: bool = True) -> BlockAllocator:

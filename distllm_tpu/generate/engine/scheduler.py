@@ -36,12 +36,17 @@ Policy contract (both implementations, tested in lockstep):
 Borrowed prefixes (automatic prefix caching, docs/prefix_caching.md): a
 request's block row may start with blocks OWNED BY THE PREFIX CACHE —
 attached at ``add`` (cache hit) or marked afterwards with ``lend_prefix``
-(this request's freshly prefilled prompt blocks entering the cache). The
-scheduler never returns borrowed blocks to its free list: ``finish`` and
-preemption free only the owned tail, and the cache hands evicted blocks
-back through ``release_blocks``. Refcounts/eviction policy live in
-``kv_cache.PrefixCache``; the scheduler only knows "the first N blocks of
-this row are not mine to free".
+(this request's freshly prefilled prompt blocks entering the cache, OR
+blocks mid-promotion from the host/disk KV tier — the engine lends them
+the moment the promotion scatter is dispatched, so promotion-pending rows
+behave exactly like borrowed prefixes in BOTH front-ends: counted toward
+budgets, never freed to the free list mid-promotion, surviving
+preemption). The scheduler never returns borrowed blocks to its free
+list: ``finish`` and preemption free only the owned tail, and the cache
+hands evicted blocks back through ``release_blocks``. Refcounts/eviction
+policy live in ``kv_cache.PrefixCache``; the tier pools live in
+``kv_cache.HostKVTier``/``DiskKVTier``; the scheduler only knows "the
+first N blocks of this row are not mine to free".
 """
 
 from __future__ import annotations

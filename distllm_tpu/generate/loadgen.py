@@ -79,6 +79,13 @@ class LoadgenConfig:
     output_tokens: tuple[int, int] = (4, 24)
     vocab_size: int = 2048
     temperature: float = 0.0  # greedy: deterministic across A/B arms
+    # Engine paged-pool override (blocks), consumed by the engine-building
+    # callers (scripts/loadgen.py CLI, the bench gen_tier stage) rather
+    # than by build_workload: sizing the pool BELOW the workload's warm
+    # working set forces HBM-tier eviction, so CPU smokes can exercise
+    # the prefix-cache spill/promote tiers with tiny prompts instead of
+    # chip-scale ones. None = the caller's default pool.
+    cache_blocks: int | None = None
 
 
 def build_workload(cfg: LoadgenConfig) -> list[Arrival]:
@@ -148,6 +155,10 @@ class LoadReport:
     cold_requests: int
     roofline: dict[str, dict[str, float]]
     tokens_by_request: list[list[int]] = field(default_factory=list)
+    # Schedule-relative TTFT per request, in arrival order (None = the
+    # request never emitted). What lets the gen_tier stage compare
+    # warm-session TTFT across tier-on/off arms request by request.
+    ttft_by_request: list = field(default_factory=list)
 
     def to_fragment(self, prefix: str) -> dict:
         """Flatten into ``{prefix}key`` fields for a bench stage record."""
@@ -257,8 +268,16 @@ def run_loadgen(
     # step()-driven runs leave finished requests parked in the engine's
     # finished map (generate_ids is what normally pops them); drop this
     # run's entries so back-to-back loadgen arms don't accumulate them.
+    # t_enqueue was re-anchored to the scheduled arrival above, so the
+    # harvested TTFTs are schedule-relative like the histograms.
+    ttft_by_request: list = []
     for rid in order:
-        engine._finished.pop(rid, None)
+        finished = engine._finished.pop(rid, None)
+        ttft_by_request.append(
+            round(finished.t_first_token - finished.t_enqueue, 6)
+            if finished is not None and finished.t_first_token
+            else None
+        )
 
     percentiles: dict[str, float | None] = {}
     for name, hist in _LIFECYCLE_HISTOGRAMS.items():
@@ -333,4 +352,5 @@ def run_loadgen(
         cold_requests=len(schedule) - warm,
         roofline=engine.roofline_summary(baseline=roofline_before),
         tokens_by_request=[tokens_by_rid[rid] for rid in order],
+        ttft_by_request=ttft_by_request,
     )

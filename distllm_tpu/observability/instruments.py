@@ -121,6 +121,65 @@ PREFIX_COW_COPIES = _registry.counter(
     'distllm_prefix_cache_cow_copies_total',
     'Copy-on-write block copies (full-cover aligned prefix hits).',
 )
+
+# --------------------------------------------- prefix-cache tier hierarchy
+# HBM -> host-RAM -> disk spill/promote tiers (EngineConfig.
+# host_kv_tier_bytes / disk_kv_tier_dir; docs/prefix_caching.md "Tier
+# hierarchy"). Label values are the fixed TIER_LABELS below.
+TIER_LABELS = ('hbm', 'host', 'disk')
+PREFIX_TIER_HITS = _registry.counter(
+    'distllm_prefix_tier_hits_total',
+    'Prefix-cache block lookups served per tier: hbm = live paged-pool '
+    'blocks (no work), host = host-RAM pool (async promotion), disk = '
+    'persisted spill files (load + promotion).',
+    labelnames=('tier',),
+)
+PREFIX_TIER_MISSES = _registry.counter(
+    'distllm_prefix_tier_misses_total',
+    'Prefix-cache lookup walks that stopped at this tier — the lowest '
+    'tier consulted found nothing, so the remaining prompt re-prefills.',
+    labelnames=('tier',),
+)
+PREFIX_TIER_SPILLS = _registry.counter(
+    'distllm_prefix_tier_spills_total',
+    'KV blocks spilled INTO each tier (host = device→host fetch of an '
+    'evicted block, disk = write-through persistence of that spill).',
+    labelnames=('tier',),
+)
+PREFIX_TIER_PROMOTIONS = _registry.counter(
+    'distllm_prefix_tier_promotions_total',
+    'KV blocks promoted OUT of each tier toward the device pool (host = '
+    'async device_put back into paged blocks, disk = file load into the '
+    'host pool).',
+    labelnames=('tier',),
+)
+PREFIX_TIER_BYTES = _registry.gauge(
+    'distllm_prefix_tier_bytes',
+    'Bytes of spilled KV currently held per tier (hbm KV bytes are '
+    'tracked by distllm_kv_cache_hbm_bytes).',
+    labelnames=('tier',),
+)
+PREFIX_TIER_EVICTIONS = _registry.counter(
+    'distllm_prefix_tier_evictions_total',
+    'Blocks evicted from each tier under its own pressure: hbm = '
+    'pool-pressure LRU eviction out of the device cache (spilled when a '
+    'host tier exists, dropped otherwise), host = host-pool byte-budget '
+    'LRU, disk = disk byte-budget LRU (always a final drop).',
+    labelnames=('tier',),
+)
+PREFIX_TIER_DROPPED_BLOCKS = _registry.counter(
+    'distllm_prefix_tier_dropped_blocks_total',
+    'Evicted KV blocks dropped outright — no lower tier existed to catch '
+    'them, so the prefix must fully re-prefill on its next arrival. The '
+    'attributable cost of cache pressure in incident bundles.',
+)
+for _tier in TIER_LABELS:
+    PREFIX_TIER_HITS.labels(tier=_tier)
+    PREFIX_TIER_MISSES.labels(tier=_tier)
+    PREFIX_TIER_SPILLS.labels(tier=_tier)
+    PREFIX_TIER_PROMOTIONS.labels(tier=_tier)
+    PREFIX_TIER_BYTES.labels(tier=_tier)
+    PREFIX_TIER_EVICTIONS.labels(tier=_tier)
 ENGINE_PREFILL_CHUNKS = _registry.counter(
     'distllm_engine_prefill_chunks_total',
     'Chunked-prefill dispatches (uncached tails split under '
@@ -318,6 +377,11 @@ FLIGHT_KINDS = frozenset({
                 # carries prefill_tokens/prefill_rows when chunk rows rode)
     'request',  # per-request lifecycle summary at finish
     'preempt',  # recompute preemption performed by prepare_decode
+    'spill',    # evicted prefix blocks fetched device→host into the KV
+                # tier (blocks/bytes/fetch_s — the audited spill sync)
+    'promote',  # host-tier blocks promoted back into the paged pool
+                # (blocks/tokens/put_s/wait_s/overlap; wait_s is the one
+                # audited completion sync of the async prefetch)
     'event',    # rare irregular events (scheduler exhaustion, ...)
     'compile',  # one startup/compile phase (observability/startup.py):
                 # backend init, warmup ladder shapes, layout migration
@@ -337,6 +401,8 @@ COMPILE_PHASES = frozenset({
     'prefill',            # one (batch, bucket) prefill warmup shape
     'prefill_paged',      # paged-context prefill twin of that shape
     'cow_copy',           # prefix-cache copy-on-write block copy
+    'tier_promote',       # KV-tier gather/scatter ladder (spill fetch +
+                          # promotion write-back shapes)
     'decode_window',      # the fused decode window (+ merge helper)
     'mixed_window',       # one chunk-bucket mixed-window shape
     'spec_window',        # the speculative verify window

@@ -49,9 +49,18 @@ from pathlib import Path
 # the trajectory gate even when tok/s noise hides it.
 _LOWER_BETTER_TOKENS = ('ttft', 'tpot', 'queue_wait', 'warmup_secs')
 _HIGHER_BETTER_SUFFIXES = ('value', 'mfu', 'vs_baseline')
+# 'promotion_overlap' gates the gen_tier stage's KV-tier prefetch
+# efficiency (1 - blocking wait / promotion span, docs/prefix_caching.md
+# "Tier hierarchy"): overlap falling means host→device promotions stopped
+# hiding behind decode windows. The stage's warm-TTFT metrics gate
+# lower-better via the 'ttft' token (gen_tier_warm_ttft_s /
+# gen_tier_cold_ttft_s), and gen_tier_warm_ttft_speedup higher-better via
+# the 'speedup' override above, so a tier regression trips the gate from
+# either side. Raw spill/promotion COUNTS stay informational — workload-
+# dependent volume, not quality.
 _HIGHER_BETTER_TOKENS = (
     'goodput', 'accept_rate', 'hit_rate', 'tok_s', 'mfu_measured',
-    'bw_util_measured',
+    'bw_util_measured', 'promotion_overlap',
 )
 
 

@@ -43,6 +43,19 @@ def main(argv: list[str] | None = None) -> int:
                         help='tiny model dims (CPU smoke) instead of 7B')
     parser.add_argument('--max-num-seqs', type=int, default=None)
     parser.add_argument('--no-attribution', action='store_true')
+    parser.add_argument(
+        '--cache-blocks', type=int, default=None,
+        help='paged-pool size override (blocks); size it below the warm '
+             'working set to force prefix-cache eviction/spill '
+             '(LoadgenConfig.cache_blocks)')
+    parser.add_argument(
+        '--host-tier-bytes', type=int, default=0,
+        help='host-RAM KV tier byte budget (0 = tier off; '
+             'docs/prefix_caching.md "Tier hierarchy")')
+    parser.add_argument(
+        '--disk-tier-dir', type=str, default=None,
+        help='optional disk KV tier directory (persists spilled blocks '
+             'across engine restarts; needs --host-tier-bytes)')
     args = parser.parse_args(argv)
 
     import jax
@@ -72,9 +85,21 @@ def main(argv: list[str] | None = None) -> int:
     if args.max_num_seqs:
         max_num_seqs = args.max_num_seqs
 
+    load_cfg = LoadgenConfig(
+        seed=args.seed,
+        num_requests=args.requests,
+        rate_rps=args.rate,
+        num_sessions=args.sessions,
+        warm_fraction=args.warm_fraction,
+        prefix_tokens=args.prefix_tokens,
+        vocab_size=model_cfg.vocab_size,
+        cache_blocks=args.cache_blocks,
+    )
     engine_cfg = EngineConfig(
         block_size=16,
-        num_blocks=num_blocks,
+        num_blocks=load_cfg.cache_blocks or num_blocks,
+        host_kv_tier_bytes=args.host_tier_bytes,
+        disk_kv_tier_dir=args.disk_tier_dir,
         max_num_seqs=max_num_seqs,
         max_model_len=max_model_len,
         decode_steps=decode_steps,
@@ -92,18 +117,13 @@ def main(argv: list[str] | None = None) -> int:
     engine = LLMEngine(model_cfg, params, _Tok(), engine_cfg, own_params=True)
     engine.warmup()
 
-    workload = build_workload(LoadgenConfig(
-        seed=args.seed,
-        num_requests=args.requests,
-        rate_rps=args.rate,
-        num_sessions=args.sessions,
-        warm_fraction=args.warm_fraction,
-        prefix_tokens=args.prefix_tokens,
-        vocab_size=model_cfg.vocab_size,
-    ))
+    workload = build_workload(load_cfg)
     report = run_loadgen(engine, workload)
     fragment = report.to_fragment('loadgen_')
     fragment['loadgen_device'] = str(jax.devices()[0].device_kind)
+    if engine.kv_tier is not None:
+        for key, value in engine.tier_summary().items():
+            fragment[f'loadgen_tier_{key}'] = value
     print(json.dumps(fragment))
     return 0
 

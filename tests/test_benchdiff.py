@@ -137,3 +137,17 @@ def test_library_surface_matches_cli():
     assert benchdiff.gate_direction('gen_load_ttft_p95_s') == 'lower'
     assert benchdiff.gate_direction('warmup_secs') == 'lower'
     assert benchdiff.gate_direction('n_tokens') is None
+    # gen_tier (KV-tier) metrics: warm/cold TTFT gate lower-better,
+    # promotion overlap and hit rate higher-better, the speedup ratio
+    # higher-better despite its 'ttft' substring, and raw spill /
+    # promotion counts stay informational.
+    assert benchdiff.gate_direction('gen_tier_warm_ttft_s') == 'lower'
+    assert benchdiff.gate_direction('gen_tier_cold_ttft_s') == 'lower'
+    assert benchdiff.gate_direction('gen_tier_warm_ttft_speedup') == 'higher'
+    assert (
+        benchdiff.gate_direction('gen_tier_promotion_overlap') == 'higher'
+    )
+    assert benchdiff.gate_direction('gen_tier_hit_rate') == 'higher'
+    assert benchdiff.gate_direction('gen_tier_spills') is None
+    assert benchdiff.gate_direction('gen_tier_promotions') is None
+    assert benchdiff.gate_direction('gen_tier_spilled_blocks') is None

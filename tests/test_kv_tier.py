@@ -1,0 +1,327 @@
+"""Host-RAM/disk KV tier for the prefix cache (ISSUE 14 tentpole):
+HostKVTier LRU/byte-budget units, DiskKVTier round-trip + restart
+persistence, spill→promote bit-exactness against never-evicted KV
+(token identity with the tier on/off under greedy fp32), and PrefixCache
+refcount invariants under cascaded eviction (docs/prefix_caching.md
+"Tier hierarchy")."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from distllm_tpu.generate.engine import (
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from distllm_tpu.generate.engine.kv_cache import (
+    DiskKVTier,
+    HostKVTier,
+    block_digests,
+)
+from distllm_tpu.models import mistral
+
+
+def _digest(i: int) -> bytes:
+    return block_digests(list(range(i * 4 + 1, i * 4 + 5)), 4)[0]
+
+
+def _block(i: int, nbytes: int = 256) -> tuple[np.ndarray, np.ndarray]:
+    """One fake per-block KV pair of ``nbytes`` total (k + v)."""
+    half = nbytes // 2
+    k = np.full((half // 4,), i, np.float32)
+    return k, k + 1
+
+
+# ------------------------------------------------------------ host tier
+def test_host_tier_lru_order_and_byte_budget():
+    tier = HostKVTier(max_bytes=3 * 256)
+    for i in range(3):
+        assert tier.put(_digest(i), *_block(i))
+    assert tier.num_blocks == 3 and tier.bytes_used == 3 * 256
+    # get() refreshes LRU: 0 becomes most-recent, so inserting a fourth
+    # block must evict 1 (the oldest untouched), never 0.
+    k0, v0 = tier.get(_digest(0))
+    assert k0[0] == 0 and v0[0] == 1
+    tier.put(_digest(3), *_block(3))
+    assert tier.bytes_used == 3 * 256  # budget enforced
+    assert tier.get(_digest(1)) is None  # LRU victim
+    assert tier.get(_digest(0)) is not None  # refreshed entry survived
+    # Duplicate put: first writer wins, no double-counted bytes.
+    assert not tier.put(_digest(0), *_block(9))
+    assert tier.bytes_used == 3 * 256
+    assert tier.get(_digest(0))[0][0] == 0
+
+
+def test_host_tier_lookup_is_membership_only():
+    tier = HostKVTier(max_bytes=2 * 256)
+    tier.put(_digest(0), *_block(0))
+    tier.put(_digest(1), *_block(1))
+    # lookup must NOT refresh LRU (it runs in add_request's walk): after
+    # looking 0 up, 0 is still the eviction victim.
+    assert tier.lookup(_digest(0)) == 'host'
+    assert tier.lookup(_digest(7)) is None
+    tier.put(_digest(2), *_block(2))
+    assert tier.get(_digest(0)) is None
+
+
+# ------------------------------------------------------------ disk tier
+def test_disk_tier_round_trip_and_budget(tmp_path):
+    tier = DiskKVTier(tmp_path, max_bytes=1 << 20)
+    k = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    v = k * 2
+    assert tier.put(_digest(0), k, v)
+    assert tier.contains(_digest(0))
+    rk, rv = tier.get(_digest(0))
+    assert rk.dtype == k.dtype and rk.shape == k.shape
+    np.testing.assert_array_equal(rk, k)
+    np.testing.assert_array_equal(rv, v)
+    # bf16 KV round-trips byte-exactly through the raw-bytes format.
+    import jax.numpy as jnp
+
+    kb = np.asarray(jnp.arange(8, dtype=jnp.bfloat16).reshape(2, 4))
+    assert tier.put(_digest(1), kb, kb)
+    rb, _ = tier.get(_digest(1))
+    assert rb.dtype == kb.dtype
+    assert rb.tobytes() == kb.tobytes()
+    # Byte budget: a tiny-budget tier keeps only the newest entries.
+    small = DiskKVTier(tmp_path / 'small', max_bytes=300)
+    small.put(_digest(2), *_block(2))
+    small.put(_digest(3), *_block(3))
+    assert not small.contains(_digest(2))
+    assert small.contains(_digest(3))
+
+
+def test_disk_tier_index_rebuilds_across_instances(tmp_path):
+    a = DiskKVTier(tmp_path, max_bytes=1 << 20)
+    a.put(_digest(0), *_block(0))
+    a.put(_digest(1), *_block(1))
+    b = DiskKVTier(tmp_path, max_bytes=1 << 20)  # fresh process stand-in
+    assert b.num_blocks == 2
+    assert b.get(_digest(0)) is not None
+
+
+def test_host_tier_write_through_and_disk_fallback(tmp_path):
+    disk = DiskKVTier(tmp_path, max_bytes=1 << 20)
+    tier = HostKVTier(max_bytes=256, disk=disk)  # host holds ONE block
+    tier.put(_digest(0), *_block(0))
+    tier.put(_digest(1), *_block(1))  # evicts 0 from host; disk keeps it
+    assert disk.num_blocks == 2  # write-through persisted both
+    assert tier.lookup(_digest(0)) == 'disk'
+    k0, _ = tier.get(_digest(0))  # disk hit re-enters the host pool
+    assert k0[0] == 0
+
+
+# ----------------------------------------------------------------- engine
+def _tiny_engine(**cfg_kwargs):
+    cfg = mistral.MistralConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        intermediate_size=64,
+        dtype='float32',
+    )
+    params = mistral.init(jax.random.PRNGKey(0), cfg)
+
+    class IdTokenizer:
+        eos_id = None
+
+        def decode(self, ids):
+            return ' '.join(str(i) for i in ids)
+
+    engine = LLMEngine(
+        cfg,
+        params,
+        IdTokenizer(),
+        EngineConfig(
+            block_size=4,
+            prefer_native_allocator=False,
+            enable_prefix_cache=True,
+            **cfg_kwargs,
+        ),
+    )
+    return cfg, params, engine
+
+
+def _dense_greedy(cfg, params, prompt, n_tokens):
+    ids = list(prompt)
+    for _ in range(n_tokens):
+        arr = np.asarray([ids], np.int32)
+        hidden = mistral.apply(params, cfg, arr, np.ones_like(arr))
+        lg = mistral.logits(params, cfg, hidden[:, -1])
+        ids.append(int(np.argmax(np.asarray(lg)[0])))
+    return ids[len(prompt):]
+
+
+GREEDY = SamplingParams(temperature=0.0, max_tokens=4)
+# 11-usable-block pool vs 24-token (6-block) prompts: every admission
+# after the first evicts cached blocks — constant tier churn.
+TIER_POOL = dict(num_blocks=12, max_num_seqs=2, max_model_len=48)
+PROMPT_A = list(range(1, 25))
+PROMPT_B = list(range(30, 54))
+
+
+def test_spill_promote_round_trip_bit_exact():
+    """Acceptance: a spilled-then-promoted prefix generates byte-identical
+    tokens to the dense reference AND to a tier-off engine (greedy fp32),
+    with >= 1 spill and >= 1 promotion actually recorded."""
+    cfg, params, on = _tiny_engine(host_kv_tier_bytes=64 << 20, **TIER_POOL)
+    _, _, off = _tiny_engine(**TIER_POOL)
+    for prompt in (PROMPT_A, PROMPT_B, PROMPT_A):
+        got_on = on.generate_ids([prompt], GREEDY)[0]
+        got_off = off.generate_ids([prompt], GREEDY)[0]
+        assert got_on == got_off == _dense_greedy(cfg, params, prompt, 4)
+    # The B run evicted A's blocks into the tier; the second A promoted.
+    assert on.tier_summary()['spilled_blocks'] > 0
+    assert on._stats['tier_promotions'] >= 1
+    assert on._stats['tier_promoted_blocks'] >= 1
+    assert off.kv_tier is None
+
+
+def test_refcount_invariants_under_cascaded_eviction():
+    """free + cache-held == usable pool after a workload that spilled,
+    promoted, and dropped through the cascade; host tier stays within
+    budget. The no-leak twin of test_prefix_cache's eviction test."""
+    cfg, params, engine = _tiny_engine(
+        host_kv_tier_bytes=3 * 2 * 2 * 4 * 4 * 16 * 4,  # ~3 blocks
+        **TIER_POOL,
+    )
+    rng = np.random.default_rng(3)
+    for _ in range(8):
+        prompt = list(rng.integers(1, 64, size=17))
+        out = engine.generate_ids([prompt], GREEDY)[0]
+        assert out == _dense_greedy(cfg, params, prompt, 4)
+    usable = TIER_POOL['num_blocks'] - 1
+    assert (
+        engine.sched.num_free_blocks + engine.prefix_cache.num_cached
+        == usable
+    )
+    assert engine.kv_tier.bytes_used <= engine.kv_tier.max_bytes
+    assert engine.tier_summary()['spilled_blocks'] > 0
+
+
+def test_disk_tier_persists_across_engine_restart(tmp_path):
+    """Cold-start warm TTFT: a FRESH engine on the same digest chain
+    promotes from the previous engine's disk spills and emits identical
+    tokens."""
+    cfg, params, first = _tiny_engine(
+        host_kv_tier_bytes=64 << 20,
+        disk_kv_tier_dir=str(tmp_path),
+        **TIER_POOL,
+    )
+    want_a = _dense_greedy(cfg, params, PROMPT_A, 4)
+    assert first.generate_ids([PROMPT_A], GREEDY)[0] == want_a
+    # Force A's blocks through eviction so the spill reaches disk.
+    first.generate_ids([PROMPT_B], GREEDY)
+    assert first.kv_tier.disk.num_blocks > 0
+    first.shutdown()
+
+    _, _, fresh = _tiny_engine(
+        host_kv_tier_bytes=64 << 20,
+        disk_kv_tier_dir=str(tmp_path),
+        **TIER_POOL,
+    )
+    assert fresh.generate_ids([PROMPT_A], GREEDY)[0] == want_a
+    assert fresh._stats['tier_promotions'] >= 1
+    assert fresh._stats.get('prefix_hit_tokens', 0) > 0
+
+
+def test_promotion_survives_warmup_and_preemption_pressure():
+    """The tier under the production serving-loop shape: warmup first
+    (tier gather/scatter ladder compiles without state damage), then a
+    preemption-heavy workload — outputs stay dense-exact."""
+    cfg, params, engine = _tiny_engine(
+        host_kv_tier_bytes=64 << 20,
+        num_blocks=14,
+        max_num_seqs=3,
+        max_model_len=48,
+        decode_steps=4,
+        pipeline_depth=2,
+    )
+    engine.warmup()
+    assert engine.sched.num_running == 0
+    stem = list(range(1, 13))
+    prompts = [stem + [20 + i] for i in range(3)] + [PROMPT_B[:9]]
+    for _ in range(2):  # second pass re-arrives after eviction/spill
+        outs = engine.generate_ids(prompts, GREEDY)
+        for p, o in zip(prompts, outs):
+            assert o == _dense_greedy(cfg, params, p, 4), p
+
+
+def test_tier_config_validation():
+    with pytest.raises(ValueError, match='enable_prefix_cache'):
+        EngineConfig(host_kv_tier_bytes=1 << 20)
+    with pytest.raises(ValueError, match='host_kv_tier_bytes'):
+        EngineConfig(
+            enable_prefix_cache=True, disk_kv_tier_dir='/tmp/x'
+        )
+
+
+# -------------------------------------------- gen_tier bench stage (smoke)
+@pytest.mark.slow  # two engine warmups + two open-loop arms (~2 min); the
+# fast tier covers the same contract in-process via the engine tests above
+def test_gen_tier_stage_cpu_smoke(tmp_path):
+    """Acceptance smoke: at a paged pool sized below the warm working
+    set, the gen_tier fragment shows (1) warm-session TTFT with the tier
+    on below the tier-off cold TTFT, (2) >= 1 recorded spill and >= 1
+    promotion, and (3) tier on/off token identity under greedy fp32.
+    Run directly: ``JAX_PLATFORMS=cpu DISTLLM_BENCH_SMALL=1 python
+    bench.py --stage gen_tier``."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS='cpu',
+        DISTLLM_BENCH_SMALL='1',
+        DISTLLM_BENCH_RECORD_DIR=str(tmp_path),
+        DISTLLM_BENCH_BUNDLE_DIR=str(tmp_path / 'bundles'),
+        DISTLLM_BENCH_WATCHDOG_S='0',
+    )
+    proc = subprocess.run(
+        [sys.executable, str(repo / 'bench.py'), '--stage', 'gen_tier'],
+        capture_output=True, text=True, timeout=420, cwd=repo, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    fragment = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert 'gen_tier_error' not in fragment, fragment.get('gen_tier_error')
+    assert fragment['gen_tier_tokens_identical'] is True
+    assert fragment['gen_tier_spills'] >= 1
+    assert fragment['gen_tier_promotions'] >= 1
+    assert (
+        fragment['gen_tier_warm_ttft_s'] < fragment['gen_tier_cold_ttft_s']
+    )
+    assert fragment['gen_tier_warm_ttft_speedup'] > 1.0
+    assert 0.0 <= fragment['gen_tier_promotion_overlap'] <= 1.0
+
+
+def test_tier_metrics_exported(tmp_path):
+    from distllm_tpu.observability import render_prometheus
+
+    _, _, engine = _tiny_engine(
+        host_kv_tier_bytes=64 << 20,
+        disk_kv_tier_dir=str(tmp_path),
+        **TIER_POOL,
+    )
+    for prompt in (PROMPT_A, PROMPT_B, PROMPT_A):
+        engine.generate_ids([prompt], GREEDY)
+    text = render_prometheus()
+    for series in (
+        'distllm_prefix_tier_hits_total',
+        'distllm_prefix_tier_misses_total',
+        'distllm_prefix_tier_spills_total',
+        'distllm_prefix_tier_promotions_total',
+        'distllm_prefix_tier_bytes',
+        'distllm_prefix_tier_evictions_total',
+        'distllm_prefix_tier_dropped_blocks_total',
+    ):
+        assert series in text, series

@@ -35,6 +35,19 @@ def test_build_workload_deterministic():
     assert a != c
 
 
+def test_cache_blocks_is_an_engine_knob_not_a_workload_knob():
+    """cache_blocks overrides the ENGINE pool size (so CPU smokes can
+    force HBM-tier eviction with tiny pools — the gen_tier stage); the
+    workload itself must be byte-identical across pool sizes, or tier
+    on/off A/Bs would silently measure different traffic."""
+    a = build_workload(LoadgenConfig(seed=7, num_requests=40))
+    b = build_workload(
+        LoadgenConfig(seed=7, num_requests=40, cache_blocks=48)
+    )
+    assert a == b
+    assert LoadgenConfig().cache_blocks is None
+
+
 def test_build_workload_poisson_arrivals_and_mix():
     cfg = LoadgenConfig(
         seed=0, num_requests=200, rate_rps=10.0, num_sessions=3,
