@@ -32,11 +32,11 @@ record and a global wall-clock deadline:
   composed from whatever the run record holds — so an external kill still
   publishes every completed stage;
 - stages run cheapest-first (embed → embed_q → gen → gen_prefix →
-  gen_mixed → gen_spec → gen_kernel → gen_load → gen_tier → gen_q: embed
-  warmups are minutes, ``gen_prefix``/``gen_mixed``/``gen_spec``/
-  ``gen_load``/``gen_tier`` and ``gen_kernel``'s XLA arm reuse ``gen``'s
-  compile cache, and int8 ``gen_q``'s cold warmup — 22–45 min in round 4
-  — goes last);
+  gen_mixed → gen_spec → gen_kernel → gen_load → gen_tier → gen_chaos →
+  gen_q: embed warmups are minutes, ``gen_prefix``/``gen_mixed``/
+  ``gen_spec``/``gen_load``/``gen_tier``/``gen_chaos`` and
+  ``gen_kernel``'s XLA arm reuse ``gen``'s compile cache, and int8
+  ``gen_q``'s cold warmup — 22–45 min in round 4 — goes last);
 - a failing or SIGTERM'd stage dumps a debug bundle (flight ring, metrics,
   traces — ``observability.dump_debug_bundle``) so a dead stage still
   explains itself, and gen stages run under a ``StallWatchdog``.
@@ -1527,6 +1527,217 @@ def _stage_gen_tier() -> dict:
     return out
 
 
+def _stage_gen_chaos() -> dict:
+    """Chaos serving stage (docs/resilience.md): the open-loop Poisson
+    loadgen driven through a DETERMINISTIC fault schedule, gating that the
+    resilience layer actually survives what it claims to.
+
+    Three arms on one engine:
+
+    - **clean** (cold cache): the fault-free baseline token streams;
+    - **chaos** (same workload, faults armed): dispatch raises, a window
+      stall, and an injected scheduler exhaustion fire on a fixed call
+      schedule while the loadgen keeps offering load — records
+      goodput-under-fault, recovery count, retries, and quarantines;
+    - **overload** (denser schedule, admission control ON with a tight
+      SLO): shed rate + Retry-After behavior, informational by design
+      (shed volume is offered-load policy, not quality).
+
+    The contract checked into the fragment: every armed fault fired,
+    ≥1 recovery, zero quarantines (the schedule is survivable by
+    construction), nonzero goodput while faults were firing, and chaos
+    tokens BIT-IDENTICAL to the clean arm (greedy fp32 in the smoke
+    tier — recovery must replay, not approximate).
+    ``DISTLLM_BENCH_CHAOS=0`` skips the stage.
+    """
+    import jax
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.generate.loadgen import (
+        LoadgenConfig,
+        build_workload,
+        run_loadgen,
+    )
+    from distllm_tpu.models import mistral
+    from distllm_tpu.resilience import get_fault_injector
+
+    prefix = 'gen_chaos_'
+    if os.environ.get('DISTLLM_BENCH_CHAOS', '1') in ('', '0'):
+        return {f'{prefix}skipped': 'DISTLLM_BENCH_CHAOS=0'}
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        # fp32 so the chaos/clean identity check is bit-exact (recovery
+        # re-dispatches must replay the same stream); tiny dims keep the
+        # single warmup in the fast tier.
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='float32',
+        )
+        max_num_seqs, num_blocks, max_model_len, decode_steps = 4, 160, 128, 4
+        load_cfg = LoadgenConfig(
+            seed=0, num_requests=24, rate_rps=16.0, num_sessions=3,
+            warm_fraction=0.5, prefix_tokens=32, prompt_tokens=(8, 32),
+            output_tokens=(4, 12), vocab_size=model_cfg.vocab_size,
+        )
+        overload_cfg = LoadgenConfig(
+            seed=1, num_requests=32, rate_rps=200.0, num_sessions=3,
+            warm_fraction=0.5, prefix_tokens=32, prompt_tokens=(8, 32),
+            output_tokens=(4, 12), vocab_size=model_cfg.vocab_size,
+        )
+        slo_s, overload_slo_s, deadline_s = 2.0, 0.02, 60.0
+        fault_schedule = (
+            ('dispatch', dict(times=2, after=4)),
+            ('slow_window', dict(times=2, delay_s=0.02, after=2)),
+            ('sched_exhausted', dict(times=1, after=10)),
+        )
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+        max_num_seqs, num_blocks, max_model_len, decode_steps = (
+            32, 712, 512, 16
+        )
+        load_cfg = LoadgenConfig(
+            seed=0, num_requests=192, rate_rps=16.0, num_sessions=16,
+            warm_fraction=0.6, prefix_tokens=64, prompt_tokens=(32, 192),
+            output_tokens=(16, 96), vocab_size=model_cfg.vocab_size,
+        )
+        overload_cfg = LoadgenConfig(
+            seed=1, num_requests=128, rate_rps=256.0, num_sessions=16,
+            warm_fraction=0.6, prefix_tokens=64, prompt_tokens=(32, 192),
+            output_tokens=(16, 64), vocab_size=model_cfg.vocab_size,
+        )
+        slo_s, overload_slo_s, deadline_s = 4.0, 0.25, 120.0
+        fault_schedule = (
+            ('dispatch', dict(times=3, after=16)),
+            ('slow_window', dict(times=3, delay_s=0.2, after=8)),
+            ('sched_exhausted', dict(times=2, after=32)),
+        )
+    engine_cfg = EngineConfig(
+        block_size=16,
+        num_blocks=num_blocks,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        decode_steps=decode_steps,
+        pipeline_depth=2,
+        sampling_top_window=64,
+        enable_prefix_cache=True,
+        ttft_slo_s=slo_s,
+        request_deadline_s=deadline_s,
+        max_dispatch_retries=3,
+        retry_backoff_s=0.01,
+        attribution=True,
+    )
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
+    engine, fallback_reason = _build_engine_with_fallback(
+        model_cfg,
+        engine_cfg,
+        lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+        [[1, 2, 3]],
+        SamplingParams(temperature=0.0, max_tokens=2),
+    )
+    warmup_secs = time.perf_counter() - warmup_start
+
+    workload = build_workload(load_cfg)
+    clean = run_loadgen(engine, workload)
+
+    injector = get_fault_injector()
+    faults_by_site: dict[str, int] = {}
+    try:
+        for site, kwargs in fault_schedule:
+            injector.arm(site, **kwargs)
+        chaos = run_loadgen(engine, workload)
+        faults_by_site = {
+            site: injector.fired(site) for site, _ in fault_schedule
+        }
+    finally:
+        injector.disarm()
+
+    # Overload arm: admission control on, SLO tightened to the point the
+    # denser schedule must shed — the 429/Retry-After surface exercised
+    # end-to-end, reported informationally (shed volume is policy).
+    engine.config.ttft_slo_s = overload_slo_s
+    engine.admission_control = True
+    overload = run_loadgen(engine, build_workload(overload_cfg))
+    engine.admission_control = False
+    engine.config.ttft_slo_s = slo_s
+
+    identical = chaos.tokens_by_request == clean.tokens_by_request
+    faults_injected = sum(faults_by_site.values())
+    out = {
+        f'{prefix}metric': 'goodput + recovery under an injected fault '
+                           'schedule',
+        f'{prefix}tok_s': round(chaos.achieved_tok_s, 2),
+        f'{prefix}clean_tok_s': round(clean.achieved_tok_s, 2),
+        f'{prefix}goodput_tokens': chaos.goodput_tokens,
+        f'{prefix}goodput_frac': chaos.goodput_frac,
+        f'{prefix}recoveries': chaos.recoveries,
+        f'{prefix}retries': chaos.window_retries,
+        f'{prefix}quarantined': chaos.quarantined,
+        f'{prefix}failed_requests': chaos.failed_requests,
+        f'{prefix}faults_injected': faults_injected,
+        **{
+            f'{prefix}faults_{site}': count
+            for site, count in faults_by_site.items()
+        },
+        f'{prefix}tokens_identical': identical,
+        f'{prefix}shed_requests': overload.shed_requests,
+        f'{prefix}shed_rate': overload.shed_rate,
+        f'{prefix}overload_slo_met': overload.slo_met,
+        f'{prefix}overload_slo_missed': overload.slo_missed,
+        f'{prefix}slo_s': slo_s,
+        f'{prefix}deadline_s': deadline_s,
+        f'{prefix}warmup_secs': round(warmup_secs, 1),
+        f'{prefix}device': str(jax.devices()[0].device_kind),
+        f'{prefix}workload': _workload_fingerprint(
+            {
+                'arrivals': [
+                    [a.at_s, list(a.prompt_ids), a.max_tokens, a.session]
+                    for a in workload
+                ],
+                'faults': [
+                    [site, sorted(kwargs.items())]
+                    for site, kwargs in fault_schedule
+                ],
+                'engine': {'max_num_seqs': max_num_seqs,
+                           'num_blocks': num_blocks,
+                           'decode_steps': decode_steps},
+            }
+        ),
+        **_cache_fields(prefix, cache_before),
+    }
+    if any(count == 0 for count in faults_by_site.values()):
+        # Per-site, not total: 4 dispatch fires must not paper over a
+        # sched_exhausted schedule that never engaged its hazard point.
+        out[f'{prefix}error'] = (
+            f'armed fault site(s) never fired: {faults_by_site} — the '
+            'schedule did not engage every hazard point it targets'
+        )
+    elif not identical:
+        out[f'{prefix}error'] = (
+            'chaos/clean token mismatch — recovery must replay the '
+            'fault-free stream bit-exactly (greedy fp32), not '
+            'approximate it'
+        )
+    elif chaos.recoveries < 1:
+        out[f'{prefix}error'] = (
+            'faults fired but no recovery was recorded — the retry '
+            'ladder never engaged'
+        )
+    elif chaos.quarantined or chaos.failed_requests:
+        out[f'{prefix}error'] = (
+            f'{chaos.quarantined} quarantined / {chaos.failed_requests} '
+            'failed requests on a survivable fault schedule'
+        )
+    elif not chaos.goodput_tokens:
+        out[f'{prefix}error'] = (
+            'zero goodput under fault — the engine stopped serving '
+            'while faults were firing'
+        )
+    if fallback_reason:
+        out[f'{prefix}attn_fallback_reason'] = fallback_reason
+    return out
+
+
 def _stage_gen() -> dict:
     return _run_gen(None, 'gen_')
 
@@ -1565,7 +1776,7 @@ def _chip_peak_flops(device) -> float | None:
 # expensive coverage first, never the headline metrics.
 STAGE_ORDER = (
     'embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_spec',
-    'gen_kernel', 'gen_load', 'gen_tier', 'gen_q',
+    'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos', 'gen_q',
 )
 NOMINAL_BUDGET_S = {
     'embed': 1200.0,
@@ -1577,11 +1788,12 @@ NOMINAL_BUDGET_S = {
     'gen_kernel': 2700.0,
     'gen_load': 2700.0,
     'gen_tier': 2700.0,
+    'gen_chaos': 2700.0,
     'gen_q': 2700.0,
 }
 GEN_STAGES = frozenset(
     {'gen', 'gen_q', 'gen_prefix', 'gen_mixed', 'gen_spec', 'gen_kernel',
-     'gen_load', 'gen_tier'}
+     'gen_load', 'gen_tier', 'gen_chaos'}
 )
 # Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
 # stages stop with ~5 min to spare even if the guess is exact, and the
@@ -1828,6 +2040,7 @@ def _run_stage_entry(stage: str) -> None:
         'gen_kernel': _stage_gen_kernel,
         'gen_load': _stage_gen_load,
         'gen_tier': _stage_gen_tier,
+        'gen_chaos': _stage_gen_chaos,
     }
     watchdog = None
     watchdog_s = float(os.environ.get('DISTLLM_BENCH_WATCHDOG_S', '300') or 0)
@@ -1852,7 +2065,7 @@ def main() -> None:
         '--stage',
         choices=[
             'embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix', 'gen_mixed',
-            'gen_spec', 'gen_kernel', 'gen_load', 'gen_tier',
+            'gen_spec', 'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos',
         ],
     )
     args = parser.parse_args()

@@ -1,10 +1,13 @@
 """Hygiene rules migrated from the legacy ``tests/test_lint.py`` walks.
 
-Three rules: unused imports (ruff F401 equivalent), the raw-``print``
-telemetry ban, and the ``.free(`` block-lifecycle ban. Behavior matches
-the legacy tests bit-for-bit (same allowlists, same ``noqa`` handling)
-so the migration cannot loosen the gate; the only addition is the
-structured ``# distlint: disable=...`` escape hatch shared by every rule.
+Four rules: unused imports (ruff F401 equivalent), the raw-``print``
+telemetry ban, the ``.free(`` block-lifecycle ban (all three matching
+the legacy tests bit-for-bit — same allowlists, same ``noqa`` handling —
+so the migration cannot loosen the gate), and the
+``swallowed-exception`` rule added with the resilience layer (ISSUE 15):
+in engine/server/tier/resilience paths, an ``except`` that neither
+re-raises nor emits telemetry is a silent degradation — exactly the
+failure class "nothing degrades silently" forbids.
 """
 
 from __future__ import annotations
@@ -122,6 +125,96 @@ class RawPrintRule(Rule):
                     'raw print( telemetry — use '
                     'distllm_tpu.observability.log_event',
                 )
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    """In the serving-critical paths (engine, KV tiers, chat server,
+    resilience layer), an ``except`` handler that neither re-raises nor
+    emits ANY telemetry — ``log_event``, a metric ``.inc/.observe/.set``,
+    a flight ``.record``, a ``logging`` call, or a ``self.telemetry``
+    note — is a silent degradation: the exact failure class the
+    resilience layer exists to forbid (ISSUE 15; a swallowed tier IO
+    error was how a dead persistence tier could have served cold TTFT
+    for weeks without a single scrapeable signal). Deliberate pure
+    control-flow swallows (membership probes, best-effort cleanup)
+    carry a justified ``# distlint: disable`` on the handler line.
+    """
+
+    id = 'swallowed-exception'
+    description = (
+        'except handler in a serving path that neither re-raises nor '
+        'emits telemetry'
+    )
+
+    _SCOPE_PREFIXES = (
+        'distllm_tpu/generate/engine/',
+        'distllm_tpu/resilience/',
+    )
+    _SCOPE_FILES = ('distllm_tpu/chat_server.py',)
+
+    # Attribute calls that count as telemetry. Generous on purpose: the
+    # rule exists to surface handlers with NO signal at all, and a
+    # false "this is telemetry" match is strictly safer than forcing
+    # noise suppressions onto every legitimately-instrumented handler.
+    _TELEMETRY_ATTRS = frozenset({
+        'inc', 'dec', 'observe', 'set', 'record', 'log_event',
+        'warning', 'error', 'exception', 'critical', 'info', 'debug',
+        'setdefault',  # the engine's telemetry.setdefault(...) notes
+    })
+
+    def applies(self, source: SourceFile) -> bool:
+        return (
+            source.rel.startswith(self._SCOPE_PREFIXES)
+            or source.rel in self._SCOPE_FILES
+        )
+
+    @classmethod
+    def _emits_signal(cls, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id == 'log_event'
+                ):
+                    return True
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in cls._TELEMETRY_ATTRS
+                ):
+                    return True
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    # self.telemetry['key'] = ... / telemetry notes
+                    if isinstance(tgt, ast.Subscript) and isinstance(
+                        tgt.value, ast.Attribute
+                    ) and tgt.value.attr == 'telemetry':
+                        return True
+        return False
+
+    def check(self, source: SourceFile, project: Project):
+        assert source.tree is not None
+        for node in source.nodes():
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if self._emits_signal(node):
+                continue
+            yield self.diag(
+                source,
+                node.lineno,
+                'except handler swallows the error without re-raising '
+                'or emitting telemetry (log_event / metric / flight '
+                'record) — nothing may degrade silently in serving '
+                'paths; add a signal or a justified suppression',
+            )
 
 
 @register

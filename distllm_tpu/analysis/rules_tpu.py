@@ -424,6 +424,13 @@ class HostSyncInHotPathRule(Rule):
             'LLMEngine._process_spec_window',
             'LLMEngine._process_chunk_entries',
             'LLMEngine._run_to_completion',
+            # The pipelined loop body behind _run_to_completion's
+            # recovery wrapper (ISSUE 15), plus the recovery/deadline
+            # helpers that run between windows: none may add a stray
+            # sync (time.sleep backoff is host-only, not a device sync).
+            'LLMEngine._serve_pipelined',
+            'LLMEngine._recover',
+            'LLMEngine._expire_deadlines',
             'LLMEngine._sample_device',
             'LLMEngine._window_kmax',
             'LLMEngine._window_budget',

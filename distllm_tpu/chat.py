@@ -113,6 +113,15 @@ class ChatAppConfig(BaseConfig):
             # (docs/prefix_caching.md) is on unless the config says
             # otherwise.
             backend.setdefault('enable_prefix_cache', True)
+            # Server-side resilience defaults (docs/resilience.md): a
+            # serving replica degrades per-request, never per-process —
+            # a stuck request times out and frees its KV instead of
+            # wedging a slot forever, and a failed window retries with
+            # bounded backoff before quarantining only the affected
+            # requests. Offline/batch callers building engines directly
+            # keep the legacy propagate-first-exception contract.
+            backend.setdefault('request_deadline_s', 120.0)
+            backend.setdefault('max_dispatch_retries', 2)
         from distllm_tpu.generate import get_generator
 
         return get_generator({'name': name, **backend}, register=True)

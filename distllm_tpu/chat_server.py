@@ -32,6 +32,16 @@ Observability surface (docs/observability.md):
 - ``GET /debug/xprof?seconds=N`` — bounded on-demand ``jax.profiler``
   capture to disk (one at a time; errors reported, never fatal).
 
+Resilience surface (docs/resilience.md): an engine running SLO-aware
+admission control sheds over-SLO requests as **429** with an honest
+``Retry-After`` header; ``POST /drain?seconds=N`` stops admitting (new
+completions get 503 + ``Retry-After``), waits for in-flight requests,
+and flips ``GET /health`` to ``{"status": "draining", "ready": false}``
+with a 503 status — the readiness signal a multi-replica router polls
+(``distllm_server_ready`` is the scrape twin). Draining is one-way per
+process: a drained replica restarts (the disk KV tier makes the restart
+warm) rather than un-drains.
+
 Request-scoped tracing: every ``POST /v1/chat/completions`` accepts an
 ``X-Request-Id`` header (one is generated when absent), binds it around
 the whole retrieve/generate path (``observability.request_scope`` — spans
@@ -61,6 +71,7 @@ import uuid
 
 import distllm_tpu
 from distllm_tpu.chat import ChatAppConfig, ChatSession
+from distllm_tpu.resilience import EngineOverloaded
 from distllm_tpu.observability import (
     StallWatchdog,
     dump_debug_bundle,
@@ -134,9 +145,26 @@ def build_app(config: ChatAppConfig):
 
     # Known routes pre-register their latency/count series so the very
     # first /metrics scrape already carries the full schema.
-    known_paths = ('/v1/chat/completions', '/health', '/metrics')
+    known_paths = ('/v1/chat/completions', '/health', '/metrics', '/drain')
     for path in known_paths:
         instruments.HTTP_LATENCY.labels(path=path)
+
+    # Drain lifecycle (docs/resilience.md): POST /drain flips this, new
+    # completions get 503 + Retry-After while in-flight ones finish, and
+    # /health turns not-ready (503) so a multi-replica router stops
+    # sending traffic here. One-way per process by design — a drained
+    # replica restarts rather than un-drains (restart is the recovery
+    # unit the disk KV tier makes cheap). The SERVER_READY gauge is
+    # process-wide and LATCHES that semantic: it starts at 1.0 (set at
+    # instruments import) and only /drain ever writes it, so building a
+    # second app in a process where an earlier app drained cannot
+    # re-declare the process ready to the router — the conservative
+    # reading for a scrape-driven route-away decision.
+    # completions_in_flight counts ONLY /v1/chat/completions work (the
+    # middleware's HTTP_IN_FLIGHT also counts the health/metrics polls a
+    # draining server explicitly invites, which would keep /drain's wait
+    # spuriously nonzero).
+    state = {'draining': False, 'completions_in_flight': 0}
 
     def answer(messages, top_k, score_threshold, request_id):
         """Stateless per-request RAG (history comes from the client).
@@ -181,6 +209,18 @@ def build_app(config: ChatAppConfig):
                 return session.generator.generate([prompt])[0]
 
     async def chat_completions(request: 'web.Request') -> 'web.StreamResponse':
+        if state['draining']:
+            # Drain lifecycle: stop admitting, finish in-flight. 503 (not
+            # 429): the replica is going away, the client should try
+            # another one, soon.
+            instruments.RESILIENCE_SHED.labels(reason='draining').inc()
+            get_flight_recorder().record('shed', reason='draining')
+            return web.json_response(
+                {'error': {'message': 'server is draining', 'type':
+                           'draining'}},
+                status=503,
+                headers={'Retry-After': '5'},
+            )
         body = await request.json()
         messages = body.get('messages', [])
         if not messages:
@@ -194,9 +234,40 @@ def build_app(config: ChatAppConfig):
         model = body.get('model', 'distllm-tpu')
         request_id = _resolve_request_id(request)
         loop = asyncio.get_running_loop()
-        content = await loop.run_in_executor(
-            executor, answer, messages, top_k, score_threshold, request_id
-        )
+        state['completions_in_flight'] += 1
+        try:
+            content = await loop.run_in_executor(
+                executor, answer, messages, top_k, score_threshold,
+                request_id,
+            )
+        # distlint: disable=swallowed-exception -- the shed is fully surfaced: the engine already counted + flight-recorded it, and the 429 below lands in the HTTP middleware's status-class metric
+        except EngineOverloaded as exc:
+            # SLO-aware shedding (docs/resilience.md): the engine
+            # predicted this request's TTFT would bust the SLO and
+            # refused it at enqueue — surface the honest 429 the
+            # prediction priced, instead of a response that arrives
+            # after the client gave up.
+            return web.json_response(
+                {
+                    'error': {
+                        'message': str(exc),
+                        'type': 'overloaded',
+                        'predicted_ttft_s': round(
+                            exc.predicted_ttft_s, 3
+                        ),
+                    },
+                    'request_id': request_id,
+                },
+                status=429,
+                headers={
+                    'Retry-After': str(
+                        max(1, math.ceil(exc.retry_after_s))
+                    ),
+                    'X-Request-Id': request_id,
+                },
+            )
+        finally:
+            state['completions_in_flight'] -= 1
         if body.get('stream'):
             # Single-delta SSE streaming (reference ``chat_server.py:168-270``).
             response = web.StreamResponse(
@@ -235,13 +306,66 @@ def build_app(config: ChatAppConfig):
     async def health(request: 'web.Request') -> 'web.Response':
         # In-flight includes this very request; report the others.
         in_flight = max(0, int(instruments.HTTP_IN_FLIGHT.value) - 1)
+        draining = state['draining']
+        # Readiness for the multi-replica router (ROADMAP item 2): the
+        # body carries the flag AND the status code flips to 503 while
+        # draining, so both field-readers and code-readers route away.
         return web.json_response(
             {
-                'status': 'ok',
+                'status': 'draining' if draining else 'ok',
+                'ready': not draining,
+                'draining': draining,
                 'version': distllm_tpu.__version__,
                 'uptime_s': round(time.time() - started_at, 3),
                 'in_flight': in_flight,
                 'requests_served': int(instruments.HTTP_RESPONSES.value),
+            },
+            status=503 if draining else 200,
+        )
+
+    async def drain(request: 'web.Request') -> 'web.Response':
+        """POST /drain: stop admitting, finish in-flight
+        (docs/resilience.md "Drain lifecycle"). Flips /health to
+        not-ready immediately, then waits (bounded by ``?seconds=N``,
+        default 30) for in-flight completions to finish; ``drained`` in
+        the response says whether the wait emptied the server."""
+        try:
+            wait_s = float(request.query.get('seconds', '30'))
+        # distlint: disable=swallowed-exception -- input validation surfaced to the client as a 400 and counted by the HTTP middleware's status-class metric
+        except ValueError:
+            return web.json_response(
+                {'error': {'message': 'seconds must be a number'}},
+                status=400,
+            )
+        if not math.isfinite(wait_s):
+            return web.json_response(
+                {'error': {'message': 'seconds must be finite'}},
+                status=400,
+            )
+        wait_s = min(max(wait_s, 0.0), 300.0)
+        state['draining'] = True
+        instruments.SERVER_READY.set(0.0)
+        get_flight_recorder().record('event', event='drain_started')
+        deadline = time.monotonic() + wait_s
+
+        def completions_in_flight() -> int:
+            # ONLY completion work counts: the middleware's in-flight
+            # gauge also sees the /health polls and /metrics scrapes a
+            # draining server invites, which would report drained:false
+            # with zero real work running.
+            return max(0, int(state['completions_in_flight']))
+
+        while completions_in_flight() > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        remaining = completions_in_flight()
+        get_flight_recorder().record(
+            'event', event='drain_finished', in_flight_remaining=remaining,
+        )
+        return web.json_response(
+            {
+                'draining': True,
+                'drained': remaining == 0,
+                'in_flight_remaining': remaining,
             }
         )
 
@@ -256,6 +380,7 @@ def build_app(config: ChatAppConfig):
     async def traces(request: 'web.Request') -> 'web.Response':
         try:
             limit = int(request.query.get('limit', '100'))
+        # distlint: disable=swallowed-exception -- input validation surfaced to the client as a 400 and counted by the HTTP middleware's status-class metric
         except ValueError:
             return web.json_response(
                 {'error': {'message': 'limit must be an integer'}}, status=400
@@ -268,6 +393,7 @@ def build_app(config: ChatAppConfig):
     async def flight(request: 'web.Request') -> 'web.Response':
         try:
             limit = int(request.query.get('limit', '200'))
+        # distlint: disable=swallowed-exception -- input validation surfaced to the client as a 400 and counted by the HTTP middleware's status-class metric
         except ValueError:
             return web.json_response(
                 {'error': {'message': 'limit must be an integer'}}, status=400
@@ -284,6 +410,7 @@ def build_app(config: ChatAppConfig):
     async def perfetto(request: 'web.Request') -> 'web.Response':
         try:
             limit = int(request.query.get('limit', '2000'))
+        # distlint: disable=swallowed-exception -- input validation surfaced to the client as a 400 and counted by the HTTP middleware's status-class metric
         except ValueError:
             return web.json_response(
                 {'error': {'message': 'limit must be an integer'}}, status=400
@@ -332,6 +459,7 @@ def build_app(config: ChatAppConfig):
         gets 409; an unsupported backend gets 501, never a dead server."""
         try:
             seconds = float(request.query.get('seconds', '2'))
+        # distlint: disable=swallowed-exception -- the NaN sentinel routes to the 400 response two lines down; the client-surfaced status is the signal
         except ValueError:
             seconds = math.nan
         # NaN passes float() and slides through min/max clamps unchanged.
@@ -390,6 +518,7 @@ def build_app(config: ChatAppConfig):
     app = web.Application(middlewares=[cors])
     app.router.add_post('/v1/chat/completions', chat_completions)
     app.router.add_get('/health', health)
+    app.router.add_post('/drain', drain)
     app.router.add_get('/metrics', metrics)
     app.router.add_get('/debug/traces', traces)
     app.router.add_get('/debug/flight', flight)

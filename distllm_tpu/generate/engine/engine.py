@@ -62,6 +62,12 @@ from distllm_tpu.observability.startup import (
     record_backend_init,
 )
 from distllm_tpu.ops.sampling import sample_tokens
+from distllm_tpu.resilience.admission import (
+    EngineLoadView,
+    EngineOverloaded,
+    shed_decision,
+)
+from distllm_tpu.resilience.faults import get_fault_injector
 from distllm_tpu.utils import BaseConfig
 
 
@@ -85,6 +91,11 @@ class RequestState(Enum):
     WAITING = 'waiting'
     RUNNING = 'running'
     FINISHED = 'finished'
+    # Terminal quarantine (docs/resilience.md): the request's dispatches
+    # kept failing past the retry budget, or it outlived
+    # ``request_deadline_s``. Its blocks are freed, the error is recorded
+    # on the request, and it never re-enters the scheduler.
+    FAILED = 'failed'
 
 
 @dataclass
@@ -142,6 +153,14 @@ class Request:
     # flight record so one id correlates server spans, engine lifecycle,
     # and the Perfetto request track (docs/observability.md).
     trace_id: str | None = None
+    # --- crash-domain recovery (docs/resilience.md) ---
+    # Why the request reached a terminal state: '' while live, 'stop' /
+    # 'length' for normal finishes, 'timeout' for a request that
+    # outlived request_deadline_s, 'dispatch_failed' for quarantine
+    # after repeated dispatch failures. A FAILED request also records
+    # the error text.
+    finish_reason: str = ''
+    error: str | None = None
 
     @property
     def num_tokens(self) -> int:
@@ -210,10 +229,17 @@ class EngineConfig(BaseConfig):
     @field_validator(
         'sampling_top_window', 'prefill_chunk_tokens',
         'max_window_prefill_tokens', 'draft_k', 'host_kv_tier_bytes',
-        'disk_kv_tier_bytes',
+        'disk_kv_tier_bytes', 'max_dispatch_retries',
     )
     @classmethod
     def _non_negative_window(cls, v: int, info) -> int:
+        if v < 0:
+            raise ValueError(f'{info.field_name} must be >= 0')
+        return v
+
+    @field_validator('request_deadline_s', 'retry_backoff_s')
+    @classmethod
+    def _non_negative_seconds(cls, v: float, info) -> float:
         if v < 0:
             raise ValueError(f'{info.field_name} must be >= 0')
         return v
@@ -290,6 +316,13 @@ class EngineConfig(BaseConfig):
                 'promotions route disk → host → device '
                 '(docs/prefix_caching.md "Tier hierarchy")'
             )
+        if self.admission_control and self.ttft_slo_s <= 0:
+            raise ValueError(
+                'admission_control needs ttft_slo_s > 0: shedding is '
+                'defined as refusing load whose predicted TTFT busts the '
+                'SLO — without an SLO there is no shed threshold '
+                '(docs/resilience.md "Shedding policy")'
+            )
         return self
     # Automatic prefix caching (docs/prefix_caching.md): full prompt
     # blocks enter a hash-chain cache as they prefill; later requests
@@ -326,6 +359,33 @@ class EngineConfig(BaseConfig):
     # output tokens into distllm_engine_goodput_tokens_total — goodput,
     # the throughput a latency-bound deployment actually delivered.
     ttft_slo_s: float = 0.0
+    # --- resilience (docs/resilience.md) ---
+    # Per-request wall-clock deadline (enqueue → terminal state), in
+    # seconds; 0 disables. A request that outlives it — stuck behind a
+    # stalled window, a livelocked retry ladder, or simply abandoned —
+    # finishes with finish_reason='timeout' and FREES its KV blocks
+    # instead of holding pool capacity forever. The chat server defaults
+    # this on (ChatAppConfig.build_generator).
+    request_deadline_s: float = 0.0
+    # Crash-domain recovery: how many times a request's dispatches may
+    # fail before it is quarantined to the terminal FAILED status with a
+    # recorded error. 0 (default) preserves the legacy contract — the
+    # first dispatch exception propagates to the caller; > 0 makes the
+    # serving loop roll per-row state back, back off
+    # (retry_backoff_s * 2^attempt, capped), and retry the window, so
+    # one poison request or transient backend fault cannot take the
+    # whole batch down with it.
+    max_dispatch_retries: int = 0
+    # Base of the bounded exponential backoff between window retries.
+    retry_backoff_s: float = 0.05
+    # SLO-aware admission control (requires ttft_slo_s > 0): predict
+    # TTFT at enqueue from EWMA-measured prefill/window rates (roofline
+    # floors before traffic) and the current backlog, and REFUSE —
+    # raise resilience.EngineOverloaded with an honest Retry-After —
+    # requests whose prediction busts the SLO, instead of queueing them
+    # into guaranteed misses. Runtime-flippable via
+    # ``engine.admission_control`` (the attribution pattern).
+    admission_control: bool = False
     # Decode windows in flight during generate_ids (2 hides the
     # host<->device round trip behind the next window's compute).
     pipeline_depth: int = 2
@@ -532,6 +592,20 @@ class LLMEngine:
         # feeds the StallWatchdog's default progress signal, so a wedged
         # engine is detectable without any extra wiring.
         self.flight = get_flight_recorder()
+        # Resilience layer (docs/resilience.md): the process fault
+        # injector (inert unless a chaos schedule armed it), per-request
+        # consecutive dispatch-failure counts feeding the quarantine
+        # threshold, prefill dispatches that must re-run after a failed
+        # attempt, and the recovery backoff state.
+        self._faults = get_fault_injector()
+        self._dispatch_failures: dict[int, int] = {}
+        self._pending_prefill: list[int] = []
+        self._consecutive_failures = 0
+        # SLO-aware admission control (runtime-flippable, the
+        # attribution pattern) + the EWMA-measured predictor inputs
+        # (_record_step feeds them; roofline floors cover cold start).
+        self.admission_control = cfg.admission_control
+        self._ewma: dict[str, float] = {}
 
         model = self.model_cfg
 
@@ -1430,6 +1504,7 @@ class LLMEngine:
             return True
         try:
             return bool(jax.config.jax_compilation_cache_dir)
+        # distlint: disable=swallowed-exception -- jax builds without the cache-dir config attribute simply cannot be priced; the skip lands in the caller's xla_cost_skipped telemetry note
         except Exception:
             return False
 
@@ -1548,6 +1623,10 @@ class LLMEngine:
                 f'prompt needs {needed} KV blocks but the pool only has '
                 f'{self.kv.num_blocks - 1}; increase num_blocks'
             )
+        if self.admission_control:
+            # May raise EngineOverloaded (honest backpressure) BEFORE any
+            # engine state is touched — a shed request owns nothing.
+            self._maybe_shed(len(prompt_ids))
         from distllm_tpu.observability.tracing import current_request_id
 
         request = Request(
@@ -1627,6 +1706,87 @@ class LLMEngine:
         _metrics.ENGINE_PROMPT_TOKENS.inc(len(prompt_ids))
         return request.request_id
 
+    # ------------------------------------- SLO-aware admission (shedding)
+    def _ewma_update(
+        self, key: str, value: float, alpha: float = 0.25
+    ) -> None:
+        prev = self._ewma.get(key)
+        self._ewma[key] = (
+            value if prev is None else prev + alpha * (value - prev)
+        )
+
+    def _load_view(self) -> EngineLoadView:
+        """Snapshot of engine load for the TTFT predictor
+        (resilience/admission.py): scheduler backlog plus EWMA-measured
+        per-token prefill time and window cadence, falling back to the
+        analytic roofline floors before the first windows land.
+
+        The request scan is O(live requests) per arrival, and that is
+        self-limiting BY the policy it feeds: shedding caps the waiting
+        backlog near the SLO-equivalent token budget
+        (``slo_s / prefill_s_per_token``), so the scan cost is bounded
+        by the configured SLO, not by offered load — incremental
+        counters would trade that bound for drift risk across
+        admit/preempt/quarantine paths."""
+        cfg = self.config
+        waiting_tokens = 0
+        pending_decode = 0
+        for r in self._requests.values():
+            if r.state is RequestState.WAITING:
+                waiting_tokens += r.num_tokens
+                pending_decode += r.params.max_tokens
+            elif r.state is RequestState.RUNNING:
+                pending_decode += max(
+                    0, r.params.max_tokens - len(r.output_ids)
+                )
+        per_tok = self._ewma.get('prefill_s_per_token')
+        window_s = self._ewma.get('window_s')
+        if (
+            per_tok is None or window_s is None
+        ) and self._cost_model is not None:
+            cm = self._cost_model
+            if per_tok is None:
+                per_tok = 2.0 * cm.n_params / cm.peak_flops
+            if window_s is None:
+                window_s = (
+                    cm.weight_bytes * cm.decode_steps / cm.peak_hbm_bytes
+                )
+        return EngineLoadView(
+            waiting_tokens=waiting_tokens,
+            pending_decode_tokens=pending_decode,
+            num_waiting=self.sched.num_waiting,
+            num_running=self.sched.num_running,
+            max_num_seqs=cfg.max_num_seqs,
+            decode_steps=cfg.decode_steps,
+            prefill_s_per_token=per_tok or 0.0,
+            window_s=window_s or 0.0,
+            slo_s=cfg.ttft_slo_s,
+        )
+
+    def _maybe_shed(self, prompt_tokens: int) -> None:
+        """Shed at enqueue when the predicted TTFT busts the SLO —
+        429-style honest backpressure instead of queueing a request into
+        a guaranteed miss (docs/resilience.md "Shedding policy")."""
+        admit, predicted, retry_after = shed_decision(
+            self._load_view(), prompt_tokens
+        )
+        _metrics.RESILIENCE_PREDICTED_TTFT.observe(predicted)
+        if admit:
+            return
+        _metrics.RESILIENCE_SHED.labels(reason='overload').inc()
+        self._stats['shed_requests'] += 1
+        self.flight.record(
+            'shed',
+            reason='overload',
+            predicted_ttft_s=round(predicted, 6),
+            retry_after_s=round(retry_after, 3),
+            prompt_tokens=prompt_tokens,
+            queue_depth=self.sched.num_waiting,
+        )
+        raise EngineOverloaded(
+            predicted, retry_after, self.config.ttft_slo_s
+        )
+
     @property
     def has_unfinished(self) -> bool:
         return self.sched.has_unfinished
@@ -1652,6 +1812,10 @@ class LLMEngine:
         emitted: list[tuple[int, int]] = list(
             self._finish_promotions(defer_to, may_block=False)
         )
+        # Recovery: prefills whose earlier dispatch failed re-run before
+        # anything else — their requests hold admitted slots and blocks,
+        # and stay decode-gated until this succeeds.
+        emitted.extend(self._retry_pending_prefills(defer_to))
         admitted_any = False
         while True:
             admitted: list[Request] = []
@@ -1890,12 +2054,31 @@ class LLMEngine:
             v_host[:, i] = v_b
             idx[i] = blocks[i]
         t_host = time.monotonic()
-        k_dev, v_dev, idx_dev = self._put_many(k_host, v_host, idx)
-        with self._annotate('promote'):
-            self.kv.k, self.kv.v = self._write_promoted(
-                self.kv.k, self.kv.v, k_dev, v_dev, idx_dev
+        try:
+            # Injection site 'device_put': the promotion transfer is the
+            # one host→device path that runs against tier state rather
+            # than request state, so its failure degrades — the request
+            # falls through to cold prefill (return False), counted into
+            # distllm_prefix_tier_errors_total{tier="host"}, never raised
+            # into admission.
+            self._faults.fail('device_put')
+            k_dev, v_dev, idx_dev = self._put_many(k_host, v_host, idx)
+            with self._annotate('promote'):
+                self.kv.k, self.kv.v = self._write_promoted(
+                    self.kv.k, self.kv.v, k_dev, v_dev, idx_dev
+                )
+            token = self._probe(self.kv.k)
+        except Exception as exc:
+            _metrics.PREFIX_TIER_ERRORS.labels(tier='host').inc()
+            self._stats['tier_promotion_failures'] += 1
+            self.flight.record(
+                'event',
+                event='promotion_failed',
+                rids=[rid],
+                blocks=n,
+                error=repr(exc)[:200],
             )
-        token = self._probe(self.kv.k)
+            return False
         t_dispatch = time.monotonic()
         # Adopt NOW (not at completion): once inserted + lent the blocks
         # are cache property in both scheduler front-ends — preemption
@@ -2186,7 +2369,56 @@ class LLMEngine:
                 c_temp, c_top_p, c_min_p]
 
     # -------------------------------------------------------------- prefill
+    def _mark_prefill_retry(self, requests: list[Request]) -> None:
+        """A prefill dispatch for ``requests`` failed: gate each request
+        out of decode plans (the mixed-window prefill_target mechanism —
+        decode must never read KV the prefill never wrote) and queue it
+        for a recovery re-dispatch (``_retry_pending_prefills``). Chunk
+        progress resets to the cached prefix: re-writing already-written
+        positions is idempotent, so the retry is exact."""
+        for request in requests:
+            request.prefill_target = request.num_tokens
+            request.prefill_sent = request.num_cached_tokens
+            request.prefill_done = request.num_cached_tokens
+            if request.request_id not in self._pending_prefill:
+                self._pending_prefill.append(request.request_id)
+
+    def _retry_pending_prefills(self, defer_to=None) -> list[tuple[int, int]]:
+        """Re-dispatch prefills whose earlier attempt failed (recovery
+        path), through the paged route — tail-only over whatever KV is
+        already valid, which covers dense-path victims too (their tail is
+        the whole prompt)."""
+        if not self._pending_prefill:
+            return []
+        rids, self._pending_prefill = self._pending_prefill, []
+        requests: list[Request] = []
+        for rid in rids:
+            request = self._requests.get(rid)
+            if request is None or request.state is not RequestState.RUNNING:
+                continue  # quarantined / preempted / finished meanwhile
+            request.prefill_target = 0
+            request.prefill_sent = request.num_cached_tokens
+            request.prefill_done = request.num_cached_tokens
+            requests.append(request)
+        return self._run_prefill_paged(requests, defer_to)
+
     def _run_prefill_batch(
+        self, requests: list[Request], bucket: int, defer_to=None
+    ) -> list[tuple[int, int]]:
+        """Dense-path prefill with the recovery contract: a failure marks
+        every batched request for re-prefill before propagating, so a
+        retrying serving loop cannot decode over unwritten KV (the retry
+        routes through the paged path — bit-identical in fp32, while a
+        bf16 retry may differ bitwise from the dense kernel; chaos
+        identity guarantees are fp32, docs/resilience.md)."""
+        try:
+            self._faults.fail('dispatch')
+            return self._run_prefill_batch_inner(requests, bucket, defer_to)
+        except Exception:
+            self._mark_prefill_retry(requests)
+            raise
+
+    def _run_prefill_batch_inner(
         self, requests: list[Request], bucket: int, defer_to=None
     ) -> list[tuple[int, int]]:
         """Prefill same-bucket requests in one padded dispatch.
@@ -2390,6 +2622,24 @@ class LLMEngine:
         defer_to=None,
         sample: bool = True,
     ) -> list[tuple[int, int]]:
+        """Paged-path prefill with the recovery contract (see
+        ``_run_prefill_batch``): mark-for-retry on failure, then raise."""
+        try:
+            self._faults.fail('dispatch')
+            return self._dispatch_prefill_paged_inner(
+                spans, bucket, defer_to, sample
+            )
+        except Exception:
+            self._mark_prefill_retry([r for r, _, _ in spans])
+            raise
+
+    def _dispatch_prefill_paged_inner(
+        self,
+        spans: list[tuple[Request, int, int]],
+        bucket: int,
+        defer_to=None,
+        sample: bool = True,
+    ) -> list[tuple[int, int]]:
         """One padded paged-context prefill dispatch.
 
         ``spans`` is ``[(request, start_token, num_tokens)]``; every span's
@@ -2501,6 +2751,7 @@ class LLMEngine:
             return contextlib.nullcontext()
         try:
             return jax.profiler.TraceAnnotation(f'distllm:{kind}')
+        # distlint: disable=swallowed-exception -- annotations are optional decoration on profiler-less backends; the nullcontext fallback changes no behavior and profiler availability is reported by the capture layer
         except Exception:  # pragma: no cover - profiler-less backends
             return contextlib.nullcontext()
 
@@ -2547,6 +2798,13 @@ class LLMEngine:
         duration_s = time.monotonic() - t_start
         _metrics.ENGINE_STEPS.labels(kind=kind).inc()
         _metrics.ENGINE_STEP_SECONDS.labels(kind=kind).observe(duration_s)
+        # EWMA-measured TTFT-predictor inputs (resilience/admission.py),
+        # fed regardless of the attribution flag — admission control must
+        # keep predicting while attribution is flipped off.
+        if kind == 'prefill' and tokens > 0:
+            self._ewma_update('prefill_s_per_token', duration_s / tokens)
+        else:
+            self._ewma_update('window_s', duration_s)
         if self._cost_model is not None and self.attribution:
             cost = self._cost_model.step_cost(
                 kind,
@@ -2685,14 +2943,40 @@ class LLMEngine:
         does NOT call this — it runs the pipelined loop that keeps
         ``pipeline_depth`` windows in flight; ``step`` is the simple API for
         interactive callers (chat server streaming, tests).
+
+        Crash-domain recovery (``max_dispatch_retries > 0``,
+        docs/resilience.md) applies here like in the pipelined loop: a
+        failed dispatch is charged, backed off, and retried on the NEXT
+        step() call instead of propagating; a step that failed mid-admit
+        may under-report tokens already folded into request state, so
+        resilient callers (run_loadgen) reconcile from the finished
+        requests' ``output_ids``.
         """
-        emitted = self._admit()
-        if self.sched.num_running == 0:
+        emitted: list[tuple[int, int]] = []
+        try:
+            self._expire_deadlines()
+            emitted = self._admit()
+            if self.sched.num_running == 0:
+                return emitted
+            window = self._dispatch_window(None)
+            if window is not _DRAIN:
+                emitted.extend(self._process_window(window))
             return emitted
-        window = self._dispatch_window(None)
-        if window is not _DRAIN:
-            emitted.extend(self._process_window(window))
-        return emitted
+        except Exception as exc:
+            # A sync step has no in-flight deque: whatever window the
+            # failed step dispatched is lost with its device-side tokens.
+            # Clear the unacked lag and roll chunk progress back (the
+            # pipelined loop's abnormal-drain rule) so a recovery retry
+            # replans from host-visible state instead of waiting forever
+            # on tokens nothing will ever fetch.
+            self._unacked.clear()
+            for pending_rid in self._prefilling:
+                pending = self._requests.get(pending_rid)
+                if pending is not None:
+                    pending.prefill_sent = pending.prefill_done
+            if not self._recover(exc):
+                raise
+            return emitted
 
     def _window_budget(self, request: Request, unacked: int, k: int) -> int:
         """Tokens this request may still generate in a new window, after
@@ -2765,17 +3049,29 @@ class LLMEngine:
         """
         if self.config.draft_k:
             return self._dispatch_spec_window()
+        # Injection site 'dispatch' (docs/resilience.md): fires BEFORE
+        # any state mutation (key split, unacked counts, chunk progress),
+        # so a recovery retry replans from unchanged state — the
+        # simulation boundary for an XLA dispatch raise.
+        self._faults.fail('dispatch')
         t_start = time.monotonic()
         k = self.config.decode_steps
         kmax = self._window_kmax()
         decode_rids = None
-        if self.config.enable_mixed_batching or self._promoting:
+        if (
+            self.config.enable_mixed_batching
+            or self._promoting
+            or self._pending_prefill
+        ):
             # Promotion-pending rows mirror mixed prefill rows: they take
             # no decode steps this window and their blocks were budgeted
             # at admission, so they must be excluded from the k-token
             # guarantee — otherwise prepare_decode would allocate (and
             # possibly preempt) for rows _reserve_shortfall skipped,
             # breaking the pipelined drain-before-preempt invariant.
+            # Pending-prefill rows (a failed prefill dispatch awaiting
+            # its recovery retry) are gated the same way: decode must
+            # not read KV their prefill never wrote.
             decode_rids = [
                 rid for _, rid in self.sched.running()
                 if self._decode_ready(self._requests[rid])
@@ -2787,6 +3083,12 @@ class LLMEngine:
             self._evict_cached_blocks(
                 self._reserve_shortfall(kmax) - self.sched.num_free_blocks
             )
+            if self._faults.fire('sched_exhausted') is not None:
+                # Injection site 'sched_exhausted': the pool-pressure
+                # hazard, without needing a pool actually sized to hit it.
+                raise SchedulerExhausted(
+                    'injected scheduler exhaustion', preempted=[]
+                )
             try:
                 preempted = self.sched.prepare_decode(kmax, decode_rids)
             except SchedulerExhausted as exc:
@@ -2965,6 +3267,7 @@ class LLMEngine:
         record for ``_process_spec_window``, or ``_DRAIN`` when nothing
         can ride.
         """
+        self._faults.fail('dispatch')  # same site as the classic window
         t_start = time.monotonic()
         cfg = self.config
         draft_k = cfg.draft_k
@@ -3222,6 +3525,7 @@ class LLMEngine:
         request.prefill_done = request.num_cached_tokens
         try:
             self._prefilling.remove(request.request_id)
+        # distlint: disable=swallowed-exception -- membership-probe control flow: the victim simply was not mid-prefill, nothing degraded
         except ValueError:
             pass
 
@@ -3235,6 +3539,10 @@ class LLMEngine:
         ``_process_spec_window``."""
         if window.get('spec'):
             return self._process_spec_window(window)
+        # Injection site 'slow_window': the stall hazard — the sleep sits
+        # where a wedged device fetch would, so watchdogs and per-request
+        # deadlines see exactly what they would see in production.
+        self._faults.maybe_sleep('slow_window')
         t_fetch = time.monotonic()
         with self._annotate('fetch'):
             # distlint: disable=host-sync-in-hot-path -- the window loop's ONE designed fetch point: processing happens a window late, after the next dispatch is already in flight (pipeline_depth hides this sync)
@@ -3310,6 +3618,7 @@ class LLMEngine:
                 self._insert_prompt_blocks(request)
                 try:
                     self._prefilling.remove(rid)
+                # distlint: disable=swallowed-exception -- membership-probe control flow: a re-enrolled span may already be off the list, nothing degraded
                 except ValueError:
                     pass
                 token = int(chunk_tokens[row_i])
@@ -3318,6 +3627,25 @@ class LLMEngine:
         return emitted
 
     def _run_to_completion(self) -> None:
+        """Drive every request to a terminal state.
+
+        With ``max_dispatch_retries == 0`` (default) this is exactly the
+        legacy contract: the first dispatch exception propagates. With
+        recovery on, a failed serving pass — its in-flight windows
+        already folded back by ``_serve_pipelined``'s cleanup — charges
+        the involved requests, quarantines the ones past the retry
+        budget, backs off, and re-enters the loop: the engine either
+        recovers or fails *only* the affected requests, never wedges
+        (docs/resilience.md "Crash-domain recovery")."""
+        while True:
+            try:
+                self._serve_pipelined()
+                return
+            except Exception as exc:
+                if not self._recover(exc):
+                    raise
+
+    def _serve_pipelined(self) -> None:
         """Drive all requests to completion with ``pipeline_depth`` decode
         windows in flight, so the ~68 ms host↔device round trip is hidden
         behind the next window's compute. EOS and admission react one
@@ -3346,6 +3674,15 @@ class LLMEngine:
         self._drain_hook = drain_one
         try:
             while self.has_unfinished or inflight:
+                if self._expired_requests():
+                    # Deadline expiry frees the victims' blocks, which is
+                    # only safe with nothing in flight (an in-flight
+                    # window still writes to them) — drain first. A
+                    # deadline event is rare; the drain is cheap next to
+                    # the seconds the request already burned.
+                    while inflight:
+                        process_one()
+                    self._expire_deadlines()
                 # Deferred prefill (opt-in): first tokens stay on device
                 # (scattered into self._carried) and their fetch records
                 # join the in-flight deque instead of blocking the decode
@@ -3384,7 +3721,19 @@ class LLMEngine:
             while inflight:
                 try:
                     process_one()
-                except Exception:
+                except Exception as drain_exc:
+                    # Abnormal drain: the in-flight windows cannot be
+                    # folded back — their device-side tokens are lost
+                    # (KV writes at positions >= num_tokens are
+                    # overwritten before they are ever attended).
+                    # Recorded, never silent: a recovery retry that
+                    # starts from a drained pipeline should say so.
+                    self.flight.record(
+                        'event',
+                        event='abnormal_drain',
+                        dropped_windows=len(inflight) + 1,
+                        error=repr(drain_exc)[:200],
+                    )
                     inflight.clear()
                     self._unacked.clear()
             # The mixed analogue of clearing _unacked: a chunk span whose
@@ -3399,6 +3748,144 @@ class LLMEngine:
             raise
         finally:
             self._drain_hook = None
+
+    # ------------------------------------- crash-domain recovery (faults)
+    def _recover(self, exc: Exception) -> bool:
+        """Decide whether a failed serving pass retries
+        (docs/resilience.md "Crash-domain recovery").
+
+        True = retry: the failure is charged to every involved request
+        (the running batch — or the waiting head when admission itself
+        failed with nothing running), requests past the
+        ``max_dispatch_retries`` budget are quarantined to FAILED with
+        the error recorded, and a bounded exponential backoff sleeps off
+        transient faults. False = recovery disabled or unattributable —
+        the caller re-raises. Termination is structural: every True
+        return charges at least one live request and each request is
+        quarantined after at most ``max_dispatch_retries + 1`` charges,
+        so a permanent fault drains the request population into FAILED
+        instead of livelocking the loop.
+
+        Callers guarantee no windows are in flight (the pipelined loop's
+        exception cleanup already folded them back), so quarantine may
+        free blocks safely.
+        """
+        cfg = self.config
+        if cfg.max_dispatch_retries <= 0:
+            return False
+        involved = [rid for _, rid in self.sched.running()]
+        if not involved:
+            waiting = [
+                r.request_id
+                for r in self._requests.values()
+                if r.state is RequestState.WAITING
+            ]
+            if waiting:
+                involved = [min(waiting)]
+        if not involved:
+            return False  # nothing live to charge: unattributable
+        self._consecutive_failures += 1
+        self._stats['window_retries'] += 1
+        _metrics.RESILIENCE_RETRIES.inc()
+        for rid in involved:
+            self._dispatch_failures[rid] = (
+                self._dispatch_failures.get(rid, 0) + 1
+            )
+        self.flight.record(
+            'recovery',
+            status='retry',
+            error=repr(exc)[:200],
+            attempt=self._consecutive_failures,
+            rids=involved[:16],
+        )
+        for rid in involved:
+            if (
+                self._dispatch_failures.get(rid, 0)
+                > cfg.max_dispatch_retries
+            ):
+                request = self._requests.get(rid)
+                if request is not None:
+                    self._fail_request(
+                        request,
+                        reason='dispatch_failed',
+                        error=repr(exc)[:300],
+                    )
+        delay = cfg.retry_backoff_s * (
+            2 ** min(self._consecutive_failures - 1, 6)
+        )
+        if delay > 0:
+            time.sleep(min(delay, 2.0))
+        return True
+
+    def _expired_requests(self) -> list[Request]:
+        """Live requests past ``request_deadline_s`` (empty when the
+        deadline is off) — the cheap guard the serving loops poll."""
+        deadline = self.config.request_deadline_s
+        if deadline <= 0 or not self._requests:
+            return []
+        now = time.monotonic()
+        return [
+            r
+            for r in self._requests.values()
+            if r.state
+            in (RequestState.WAITING, RequestState.RUNNING)
+            and now - r.t_enqueue > deadline
+        ]
+
+    def _expire_deadlines(self) -> None:
+        """Quarantine every request past its wall-clock deadline with
+        ``finish_reason='timeout'``, freeing its KV blocks instead of
+        holding them forever. Callers must have no windows in flight."""
+        for request in self._expired_requests():
+            self._fail_request(
+                request,
+                reason='timeout',
+                error=(
+                    'request exceeded request_deadline_s='
+                    f'{self.config.request_deadline_s}'
+                ),
+            )
+
+    def _fail_request(
+        self, request: Request, *, reason: str, error: str
+    ) -> None:
+        """Terminal quarantine: record the error, free every resource the
+        request holds, and park it in the finished map as FAILED — never
+        a silent drop (one ``'quarantine'`` flight record + the
+        ``distllm_resilience_quarantined_requests_total{reason}``
+        counter). Callers must have no windows in flight: quarantine
+        frees blocks, and an in-flight window could still write to them.
+        """
+        rid = request.request_id
+        request.state = RequestState.FAILED
+        request.finish_reason = reason
+        request.error = error
+        request.t_finish = time.monotonic()
+        _metrics.RESILIENCE_QUARANTINED.labels(reason=reason).inc()
+        self._stats['quarantined_requests'] += 1
+        self.flight.record(
+            'quarantine',
+            request_id=rid,
+            trace_id=request.trace_id,
+            reason=reason,
+            error=error[:300],
+            prompt_tokens=len(request.prompt_ids),
+            output_tokens=len(request.output_ids),
+        )
+        self.sched.finish(rid)
+        if self.prefix_cache is not None:
+            self.prefix_cache.release(rid)
+        self._promoting.pop(rid, None)
+        self._unacked.pop(rid, None)
+        self._dispatch_failures.pop(rid, None)
+        for pending in (self._prefilling, self._pending_prefill):
+            try:
+                pending.remove(rid)
+            # distlint: disable=swallowed-exception -- membership-probe control flow: the rid simply was not mid-prefill, nothing degraded
+            except ValueError:
+                pass
+        del self._requests[rid]
+        self._finished[rid] = request
 
     def _sample_device(self, logits: jnp.ndarray, slots) -> jnp.ndarray:
         """Sample one token per row on DEVICE (no host sync)."""
@@ -3419,6 +3906,21 @@ class LLMEngine:
     def _emit_token(self, request: Request, token: int) -> None:
         # Note: the emitted token is NOT yet written to the KV cache; it is
         # fed as input on the next decode step, which writes it then.
+        if self._consecutive_failures:
+            # First token after one or more failed dispatches: the retry
+            # ladder worked — record the recovery, reset the backoff.
+            self._consecutive_failures = 0
+            self._stats['recoveries'] += 1
+            _metrics.RESILIENCE_RECOVERIES.inc()
+            self.flight.record(
+                'recovery', status='recovered',
+                request_id=request.request_id,
+            )
+        if self._dispatch_failures:
+            # Progress clears a request's failure charge: only
+            # CONSECUTIVE failures quarantine (poison containment), not
+            # failures spread across an otherwise healthy run.
+            self._dispatch_failures.pop(request.request_id, None)
         if not request.output_ids and request.t_first_token == 0.0:
             # TTFT is measured to the HOST fetch of the first token — the
             # latency a streaming client sees, including any pipelined lag.
@@ -3438,6 +3940,7 @@ class LLMEngine:
             or len(request.output_ids) >= request.params.max_tokens
             or request.num_tokens >= self.config.max_model_len
         ):
+            request.finish_reason = 'stop' if token in stops else 'length'
             self._finish(request)
 
     def _finish(self, request: Request) -> None:

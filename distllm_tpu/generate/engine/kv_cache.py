@@ -365,6 +365,7 @@ class DiskKVTier:
         for path in self._root.glob(f'*{self._SUFFIX}'):
             try:
                 stat = path.stat()
+            # distlint: disable=swallowed-exception -- benign glob/stat race (a concurrent eviction unlinked the file); the index simply never learns it
             except OSError:
                 continue
             entries.append((stat.st_mtime, path.stem, stat.st_size))
@@ -386,7 +387,11 @@ class DiskKVTier:
             try:
                 self._path(hexdigest).unlink()
             except OSError:
-                pass
+                # An eviction that cannot delete its file leaks disk
+                # bytes outside the budget — counted, never silent.
+                from distllm_tpu.observability import instruments as _im
+
+                _im.PREFIX_TIER_ERRORS.labels(tier='disk').inc()
             dropped += 1
         if dropped:
             from distllm_tpu.observability import instruments as _m
@@ -406,9 +411,35 @@ class DiskKVTier:
         with self._lock:
             return digest.hex() in self._index
 
+    def _drop_entry(self, hexdigest: str, *, unlink: bool = False) -> None:
+        """Forget one indexed entry (IO error / corruption path) and count
+        the tier error — a bad file must degrade to a miss, never raise
+        into ``add_request``'s tier walk. The error is counted ONLY when
+        the entry was still indexed: a read racing a concurrent eviction
+        (file unlinked, index popped between get()'s lock release and its
+        read) is the documented-benign miss, and counting it would let a
+        perfectly healthy tier under eviction pressure read as sick."""
+        from distllm_tpu.observability import instruments as _m
+
+        with self._lock:
+            size = self._index.pop(hexdigest, None)
+            if size is not None:
+                self._bytes -= size
+                self._publish_locked()
+        if unlink:
+            try:
+                os.unlink(self._path(hexdigest))
+            # distlint: disable=swallowed-exception -- best-effort cleanup of a file already counted as a tier error below; a second unlink failure adds no signal
+            except OSError:
+                pass
+        if size is not None:
+            _m.PREFIX_TIER_ERRORS.labels(tier='disk').inc()
+
     def put(self, digest: bytes, k: np.ndarray, v: np.ndarray) -> bool:
         """Persist one block's KV; False when already present (the file
         contents are digest-determined, so rewriting buys nothing)."""
+        from distllm_tpu.resilience.faults import get_fault_injector
+
         hexdigest = digest.hex()
         header = json.dumps(
             {'shape': list(k.shape), 'dtype': str(k.dtype)}
@@ -421,10 +452,16 @@ class DiskKVTier:
             path = self._path(hexdigest)
             tmp = path.with_suffix('.tmp')
             try:
+                get_fault_injector().fail_io('tier_io')
                 tmp.write_bytes(payload)
                 os.replace(tmp, path)
             except OSError:
-                return False  # full/read-only disk degrades to no tier
+                # Full/read-only disk degrades to no tier — counted, so
+                # a silently-dead persistence tier shows up in scrapes.
+                from distllm_tpu.observability import instruments as _m
+
+                _m.PREFIX_TIER_ERRORS.labels(tier='disk').inc()
+                return False
             self._index[hexdigest] = len(payload)
             self._bytes += len(payload)
             from distllm_tpu.observability import instruments as _m
@@ -438,30 +475,43 @@ class DiskKVTier:
         """Load one block's (K, V) host arrays; refreshes its LRU slot.
         The file read happens OUTSIDE the lock — contains() runs on the
         admission path and must not stall behind multi-megabyte cold-disk
-        reads. A concurrent eviction racing the read is just a miss."""
+        reads. A concurrent eviction racing the read is just a miss.
+        A corrupt or truncated file (bad header, short read — a torn
+        spill from a killed process, bit rot, or a foreign file wearing
+        the suffix) counts a ``distllm_prefix_tier_errors_total{tier=
+        "disk"}``, drops the entry, and returns None: the caller falls
+        through to cold prefill, never an exception in ``add_request``."""
+        from distllm_tpu.resilience.faults import get_fault_injector
+
         hexdigest = digest.hex()
         with self._lock:
             if hexdigest not in self._index:
                 return None
             self._index.move_to_end(hexdigest)
         try:
+            get_fault_injector().fail_io('tier_io')
             payload = self._path(hexdigest).read_bytes()
+        # distlint: disable=swallowed-exception -- degradation is counted: _drop_entry increments distllm_prefix_tier_errors_total{tier="disk"}
         except OSError:
-            with self._lock:
-                size = self._index.pop(hexdigest, None)
-                if size is not None:
-                    self._bytes -= size
-                    self._publish_locked()
+            self._drop_entry(hexdigest)
             return None
-        header, _, body = payload.partition(b'\n')
-        meta = json.loads(header)
-        # jnp.dtype resolves 'bfloat16' through ml_dtypes into a numpy-
-        # compatible dtype, so the round trip is byte-exact for bf16 KV.
-        dtype = np.dtype(jnp.dtype(meta['dtype']))
-        shape = tuple(meta['shape'])
-        half = len(body) // 2
-        k = np.frombuffer(body[:half], dtype=dtype).reshape(shape)
-        v = np.frombuffer(body[half:], dtype=dtype).reshape(shape)
+        try:
+            header, sep, body = payload.partition(b'\n')
+            if not sep:
+                raise ValueError('missing header line')
+            meta = json.loads(header)
+            # jnp.dtype resolves 'bfloat16' through ml_dtypes into a
+            # numpy-compatible dtype, so the round trip is byte-exact for
+            # bf16 KV.
+            dtype = np.dtype(jnp.dtype(meta['dtype']))
+            shape = tuple(int(d) for d in meta['shape'])
+            half = len(body) // 2
+            k = np.frombuffer(body[:half], dtype=dtype).reshape(shape)
+            v = np.frombuffer(body[half:], dtype=dtype).reshape(shape)
+        # distlint: disable=swallowed-exception -- degradation is counted: _drop_entry increments distllm_prefix_tier_errors_total{tier="disk"} and unlinks the corrupt file
+        except (ValueError, KeyError, TypeError):
+            self._drop_entry(hexdigest, unlink=True)
+            return None
         return k, v
 
     @property
@@ -595,8 +645,17 @@ def make_allocator(num_blocks: int, prefer_native: bool = True) -> BlockAllocato
     if prefer_native:
         try:
             return NativeBlockAllocator(num_blocks)
-        except (RuntimeError, OSError):
-            pass
+        except (RuntimeError, OSError) as exc:
+            # The Python twin is a designed drop-in (same policy, same
+            # tests), but WHICH allocator served must never be a silent
+            # guess in a perf investigation.
+            from distllm_tpu.observability.instruments import log_event
+
+            log_event(
+                f'[engine] native block allocator unavailable '
+                f'({exc!r:.120}); using the Python fallback',
+                component='engine',
+            )
     return PyBlockAllocator(num_blocks)
 
 

@@ -160,6 +160,7 @@ class PyScheduler:
             return None
         try:
             slot = self._slots.index(-1)
+        # distlint: disable=swallowed-exception -- no-free-slot is a normal admission outcome (None = defer), not a degradation; the wrapper counts deferrals
         except ValueError:
             return None
         rid = self._waiting[0]
@@ -285,6 +286,7 @@ class PyScheduler:
             self._slots[req.slot] = -1
         try:
             self._waiting.remove(rid)
+        # distlint: disable=swallowed-exception -- membership-probe control flow: finishing a RUNNING request is the common case and it is simply not in the waiting deque
         except ValueError:
             pass
 
@@ -723,6 +725,14 @@ def make_scheduler(
     if prefer_native:
         try:
             return NativeScheduler(num_blocks, block_size, max_num_seqs)
-        except (RuntimeError, OSError):
-            pass
+        except (RuntimeError, OSError) as exc:
+            # Same contract as kv_cache.make_allocator: the Python twin
+            # is a tested drop-in, but the substitution is never silent.
+            from distllm_tpu.observability.instruments import log_event
+
+            log_event(
+                f'[engine] native scheduler unavailable ({exc!r:.120}); '
+                'using the Python twin',
+                component='engine',
+            )
     return PyScheduler(num_blocks, block_size, max_num_seqs)

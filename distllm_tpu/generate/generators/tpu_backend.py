@@ -113,6 +113,39 @@ class TpuGeneratorConfig(BaseConfig):
         ge=1,
         description='n-gram length the prompt-lookup drafter matches on.',
     )
+    # Resilience knobs (docs/resilience.md). None = inherit
+    # EngineConfig's defaults; the chat server defaults the deadline and
+    # retry budget ON (ChatAppConfig.build_generator) — a serving
+    # replica must degrade per-request, not per-process.
+    ttft_slo_s: float | None = Field(
+        default=None,
+        ge=0,
+        description='TTFT service-level objective in seconds (SLO/goodput '
+        'accounting; the shed threshold when admission_control is on). '
+        '0 disables.',
+    )
+    request_deadline_s: float | None = Field(
+        default=None,
+        ge=0,
+        description='Per-request wall-clock deadline: a stuck request '
+        'finishes with finish_reason="timeout" and frees its KV blocks '
+        'instead of holding them forever. 0 disables.',
+    )
+    max_dispatch_retries: int | None = Field(
+        default=None,
+        ge=0,
+        description='Crash-domain recovery: retry a failed window this '
+        'many times (bounded backoff) before quarantining the involved '
+        'requests to FAILED with a recorded error. 0 = propagate the '
+        'first dispatch exception (the offline/batch contract).',
+    )
+    admission_control: bool | None = Field(
+        default=None,
+        description='SLO-aware shedding: predict TTFT at enqueue and '
+        'refuse (EngineOverloaded -> HTTP 429 + Retry-After) requests '
+        'whose prediction busts ttft_slo_s, instead of queueing them '
+        'into guaranteed misses. Requires ttft_slo_s > 0.',
+    )
 
     @model_validator(mode='after')
     def _attn_backend_in_catalog(self) -> 'TpuGeneratorConfig':
@@ -266,6 +299,13 @@ class TpuGenerator:
                         ),
                         ('draft_k', config.draft_k),
                         ('spec_ngram', config.spec_ngram),
+                        ('ttft_slo_s', config.ttft_slo_s),
+                        ('request_deadline_s', config.request_deadline_s),
+                        (
+                            'max_dispatch_retries',
+                            config.max_dispatch_retries,
+                        ),
+                        ('admission_control', config.admission_control),
                     )
                     if value is not None
                 },
@@ -303,15 +343,28 @@ class FakeGeneratorConfig(BaseConfig):
     name: Literal['fake'] = 'fake'
     response_template: str = 'response to: {prompt}'
     max_prompt_chars: int = 48
+    # Every Nth generate() call raises resilience.EngineOverloaded (the
+    # engine's SLO-shed signal) so the chat server's 429/Retry-After
+    # surface is testable without a real overloaded engine; 0 disables.
+    overload_every: int = 0
 
 
 class FakeGenerator:
     def __init__(self, config: FakeGeneratorConfig) -> None:
         self.config = config
+        self._calls = 0
 
     def generate(self, prompts: str | list[str]) -> list[str]:
         if isinstance(prompts, str):
             prompts = [prompts]
+        self._calls += 1
+        every = self.config.overload_every
+        if every > 0 and self._calls % every == 0:
+            from distllm_tpu.resilience import EngineOverloaded
+
+            raise EngineOverloaded(
+                predicted_ttft_s=1.25, retry_after_s=3.0, slo_s=0.5
+            )
         return [
             self.config.response_template.format(
                 prompt=p[: self.config.max_prompt_chars]

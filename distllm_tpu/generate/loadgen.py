@@ -37,6 +37,7 @@ import numpy as np
 
 from distllm_tpu.observability import instruments as _metrics
 from distllm_tpu.observability.metrics import quantile_from_cumulative
+from distllm_tpu.resilience.admission import EngineOverloaded
 
 _QUANTILES = (0.50, 0.95, 0.99)
 _LIFECYCLE_HISTOGRAMS = {
@@ -154,10 +155,23 @@ class LoadReport:
     warm_requests: int
     cold_requests: int
     roofline: dict[str, dict[str, float]]
+    # Resilience accounting (docs/resilience.md): arrivals refused by
+    # SLO-aware admission control, requests quarantined to FAILED
+    # (dispatch failures / deadline timeouts), and the engine's
+    # retry/recovery counts over this run — what the gen_chaos stage
+    # gates (recoveries, goodput-under-fault) and reports (shed rate).
+    shed_requests: int = 0
+    shed_rate: float | None = None
+    failed_requests: int = 0
+    window_retries: int = 0
+    recoveries: int = 0
+    quarantined: int = 0
     tokens_by_request: list[list[int]] = field(default_factory=list)
-    # Schedule-relative TTFT per request, in arrival order (None = the
-    # request never emitted). What lets the gen_tier stage compare
-    # warm-session TTFT across tier-on/off arms request by request.
+    # Schedule-relative TTFT per ARRIVAL, aligned to the workload order
+    # (None = shed at admission or never emitted). What lets the
+    # gen_tier stage compare warm-session TTFT across tier-on/off arms
+    # request by request; tokens_by_request is aligned the same way
+    # (shed arrivals contribute an empty list).
     ttft_by_request: list = field(default_factory=list)
 
     def to_fragment(self, prefix: str) -> dict:
@@ -187,6 +201,12 @@ class LoadReport:
             out[f'{prefix}goodput_{key}'] = (
                 round(value, 2) if value is not None else None
             )
+        out[f'{prefix}shed_requests'] = self.shed_requests
+        out[f'{prefix}shed_rate'] = self.shed_rate
+        out[f'{prefix}failed_requests'] = self.failed_requests
+        out[f'{prefix}window_retries'] = self.window_retries
+        out[f'{prefix}recoveries'] = self.recoveries
+        out[f'{prefix}quarantined'] = self.quarantined
         for kind, stats in self.roofline.items():
             out[f'{prefix}mfu_{kind}'] = stats.get('mfu')
             out[f'{prefix}bw_util_{kind}'] = stats.get('bw_util')
@@ -225,13 +245,16 @@ def run_loadgen(
         key: int(engine._stats.get(key, 0))
         for key in (
             'prefix_hit_tokens', 'goodput_tokens', 'slo_met', 'slo_missed',
+            'window_retries', 'recoveries', 'quarantined_requests',
         )
     }
     flight_total_before = engine.flight.total_recorded
     roofline_before = engine.roofline_snapshot()
 
     tokens_by_rid: dict[int, list[int]] = {}
-    order: list[int] = []
+    # One slot per ARRIVAL in schedule order; None = shed at admission.
+    arrival_rids: list[int | None] = []
+    shed = 0
     next_i = 0
     t0 = time.monotonic()
     while next_i < len(schedule) or engine.has_unfinished:
@@ -239,13 +262,21 @@ def run_loadgen(
         while next_i < len(schedule) and schedule[next_i].at_s <= now:
             arrival = schedule[next_i]
             next_i += 1
-            rid = engine.add_request(
-                list(arrival.prompt_ids),
-                SamplingParams(
-                    temperature=arrival.temperature,
-                    max_tokens=arrival.max_tokens,
-                ),
-            )
+            try:
+                rid = engine.add_request(
+                    list(arrival.prompt_ids),
+                    SamplingParams(
+                        temperature=arrival.temperature,
+                        max_tokens=arrival.max_tokens,
+                    ),
+                )
+            except EngineOverloaded:
+                # SLO-aware admission control refused the arrival —
+                # honest backpressure, counted (the engine already
+                # recorded the 'shed' flight record + metric).
+                shed += 1
+                arrival_rids.append(None)
+                continue
             # Coordinated-omission correction: if this arrival's
             # scheduled instant passed while a blocking step() held the
             # loop, add_request stamped a LATE t_enqueue — measuring
@@ -256,7 +287,7 @@ def run_loadgen(
             # schedule-relative.
             engine._requests[rid].t_enqueue = t0 + arrival.at_s
             tokens_by_rid[rid] = []
-            order.append(rid)
+            arrival_rids.append(rid)
         if engine.has_unfinished:
             for rid, tok in engine.step():
                 tokens_by_rid.setdefault(rid, []).append(tok)
@@ -269,10 +300,21 @@ def run_loadgen(
     # finished map (generate_ids is what normally pops them); drop this
     # run's entries so back-to-back loadgen arms don't accumulate them.
     # t_enqueue was re-anchored to the scheduled arrival above, so the
-    # harvested TTFTs are schedule-relative like the histograms.
+    # harvested TTFTs are schedule-relative like the histograms. The
+    # finished objects' output_ids are also the AUTHORITATIVE token
+    # streams: a recovered step() may have under-reported emissions it
+    # folded into request state while failing (docs/resilience.md).
     ttft_by_request: list = []
-    for rid in order:
+    failed = 0
+    for rid in arrival_rids:
+        if rid is None:
+            ttft_by_request.append(None)
+            continue
         finished = engine._finished.pop(rid, None)
+        if finished is not None:
+            tokens_by_rid[rid] = list(finished.output_ids)
+            if finished.error is not None:
+                failed += 1
         ttft_by_request.append(
             round(finished.t_first_token - finished.t_enqueue, 6)
             if finished is not None and finished.t_first_token
@@ -329,6 +371,10 @@ def run_loadgen(
     # has no meaningful rate (None, not inf — the report must stay
     # strict-JSON serializable).
     span = schedule[-1].at_s - schedule[0].at_s if len(schedule) > 1 else 0.0
+
+    def _stat_delta(key: str) -> int:
+        return int(engine._stats.get(key, 0)) - stats_before[key]
+
     return LoadReport(
         requests=len(schedule),
         tokens=total_tokens,
@@ -351,6 +397,15 @@ def run_loadgen(
         warm_requests=warm,
         cold_requests=len(schedule) - warm,
         roofline=engine.roofline_summary(baseline=roofline_before),
-        tokens_by_request=[tokens_by_rid[rid] for rid in order],
+        shed_requests=shed,
+        shed_rate=shed / len(schedule) if schedule else None,
+        failed_requests=failed,
+        window_retries=_stat_delta('window_retries'),
+        recoveries=_stat_delta('recoveries'),
+        quarantined=_stat_delta('quarantined_requests'),
+        tokens_by_request=[
+            tokens_by_rid.get(rid, []) if rid is not None else []
+            for rid in arrival_rids
+        ],
         ttft_by_request=ttft_by_request,
     )

@@ -173,6 +173,15 @@ PREFIX_TIER_DROPPED_BLOCKS = _registry.counter(
     'them, so the prefix must fully re-prefill on its next arrival. The '
     'attributable cost of cache pressure in incident bundles.',
 )
+PREFIX_TIER_ERRORS = _registry.counter(
+    'distllm_prefix_tier_errors_total',
+    'Tier operations that failed and degraded instead of raising into '
+    'the serving path: disk = unreadable/corrupt/truncated .kvblock '
+    'files or write IO errors (the entry is dropped and the prefix '
+    'falls through to cold prefill), host = a failed async promotion '
+    'transfer (the request falls back to cold prefill).',
+    labelnames=('tier',),
+)
 for _tier in TIER_LABELS:
     PREFIX_TIER_HITS.labels(tier=_tier)
     PREFIX_TIER_MISSES.labels(tier=_tier)
@@ -180,6 +189,7 @@ for _tier in TIER_LABELS:
     PREFIX_TIER_PROMOTIONS.labels(tier=_tier)
     PREFIX_TIER_BYTES.labels(tier=_tier)
     PREFIX_TIER_EVICTIONS.labels(tier=_tier)
+    PREFIX_TIER_ERRORS.labels(tier=_tier)
 ENGINE_PREFILL_CHUNKS = _registry.counter(
     'distllm_engine_prefill_chunks_total',
     'Chunked-prefill dispatches (uncached tails split under '
@@ -385,6 +395,15 @@ FLIGHT_KINDS = frozenset({
     'event',    # rare irregular events (scheduler exhaustion, ...)
     'compile',  # one startup/compile phase (observability/startup.py):
                 # backend init, warmup ladder shapes, layout migration
+    'fault',    # one injected fault firing (resilience/faults.py:
+                # site/fired/call — the chaos schedule made attributable)
+    'recovery', # one serving-loop retry after a failed dispatch
+                # (status=retry with the error + involved rids) or the
+                # first post-failure token (status=recovered)
+    'quarantine',  # a request forced to terminal FAILED
+                   # (reason=dispatch_failed|timeout, recorded error)
+    'shed',     # a request refused at admission (predicted_ttft_s /
+                # retry_after_s — the honest-backpressure record)
 })
 
 # Catalog of startup/compile phase kinds (observability/startup.py),
@@ -425,6 +444,69 @@ TRACE_EVENT_CATEGORIES = frozenset({
     'span',          # trace-ring spans (server middleware, RAG, stages)
     'startup',       # compile-phase slices on the dedicated startup track
 })
+
+# ------------------------------------------------- resilience / fault layer
+# Fault injection, crash-domain recovery, and SLO-aware shedding
+# (distllm_tpu/resilience/, engine recovery paths; docs/resilience.md).
+# Nothing in the resilience layer degrades silently: every injected
+# fault, retry, quarantine, timeout, and shed lands in one of these.
+FAULT_SITE_LABELS = ('dispatch', 'device_put', 'tier_io',
+                     'sched_exhausted', 'slow_window')
+RESILIENCE_FAULTS = _registry.counter(
+    'distllm_resilience_faults_injected_total',
+    'Faults fired by the deterministic injector '
+    '(distllm_tpu/resilience/faults.py), by catalogued site. Zero in '
+    'production unless DISTLLM_FAULTS armed a chaos schedule.',
+    labelnames=('site',),
+)
+RESILIENCE_RETRIES = _registry.counter(
+    'distllm_resilience_window_retries_total',
+    'Serving-loop retries after a failed dispatch (EngineConfig.'
+    'max_dispatch_retries > 0): the loop rolled per-row state back and '
+    're-dispatched with bounded backoff instead of propagating.',
+)
+RESILIENCE_RECOVERIES = _registry.counter(
+    'distllm_resilience_recoveries_total',
+    'Recoveries: the first token emitted after one or more failed '
+    'dispatches — the retry ladder worked and serving resumed.',
+)
+RESILIENCE_QUARANTINED = _registry.counter(
+    'distllm_resilience_quarantined_requests_total',
+    'Requests forced to the terminal FAILED status with a recorded '
+    'error, by reason: dispatch_failed = its dispatches kept failing '
+    'past the retry budget (poison-request containment), timeout = it '
+    'outlived EngineConfig.request_deadline_s (its KV blocks are freed '
+    'instead of held forever).',
+    labelnames=('reason',),
+)
+RESILIENCE_SHED = _registry.counter(
+    'distllm_resilience_shed_requests_total',
+    'Requests refused with honest backpressure instead of queueing past '
+    'the TTFT SLO, by reason: overload = predicted TTFT busts '
+    'ttft_slo_s at enqueue (429 + Retry-After), draining = the server '
+    'is in the /drain lifecycle (503).',
+    labelnames=('reason',),
+)
+RESILIENCE_PREDICTED_TTFT = _registry.histogram(
+    'distllm_resilience_predicted_ttft_seconds',
+    'Admission-time TTFT predictions (resilience/admission.py), '
+    'admitted and shed alike — compare against the realized '
+    'distllm_request_ttft_seconds to read the predictor\'s calibration.',
+    buckets=log_buckets(1e-3, 600.0),
+)
+for _site in FAULT_SITE_LABELS:
+    RESILIENCE_FAULTS.labels(site=_site)
+for _reason in ('dispatch_failed', 'timeout'):
+    RESILIENCE_QUARANTINED.labels(reason=_reason)
+for _reason in ('overload', 'draining'):
+    RESILIENCE_SHED.labels(reason=_reason)
+SERVER_READY = _registry.gauge(
+    'distllm_server_ready',
+    'chat_server readiness for the multi-replica router to poll: 1 = '
+    'admitting, 0 = draining (POST /drain) — /health mirrors it as the '
+    '"ready" field and a 503 status while draining.',
+)
+SERVER_READY.set(1.0)
 
 # -------------------------------------------------- watchdog / debug bundle
 WATCHDOG_STALLS = _registry.counter(

@@ -1,0 +1,36 @@
+"""Resilience layer: fault injection, TTFT-predictive admission control
+(docs/resilience.md).
+
+The crash-domain *recovery* half (window retry, quarantine, per-request
+deadlines) lives in the engine itself
+(``distllm_tpu/generate/engine/engine.py``); this package holds the
+parts that are engine-independent: the deterministic fault-injection
+framework and the shedding policy. Dependency-free — importable on any
+backend, by the server, and by tests without touching jax.
+"""
+
+from distllm_tpu.resilience.admission import (
+    EngineLoadView,
+    EngineOverloaded,
+    predict_ttft,
+    shed_decision,
+)
+from distllm_tpu.resilience.faults import (
+    FAULT_SITES,
+    FaultInjector,
+    InjectedFault,
+    get_fault_injector,
+    parse_fault_spec,
+)
+
+__all__ = [
+    'EngineLoadView',
+    'EngineOverloaded',
+    'predict_ttft',
+    'shed_decision',
+    'FAULT_SITES',
+    'FaultInjector',
+    'InjectedFault',
+    'get_fault_injector',
+    'parse_fault_spec',
+]

@@ -58,9 +58,19 @@ _HIGHER_BETTER_SUFFIXES = ('value', 'mfu', 'vs_baseline')
 # the 'speedup' override above, so a tier regression trips the gate from
 # either side. Raw spill/promotion COUNTS stay informational — workload-
 # dependent volume, not quality.
+#
+# 'recoveries' gates the gen_chaos stage (docs/resilience.md): fewer
+# recoveries on the SAME deterministic fault schedule means injected
+# faults stopped being survived — requests started failing (or the
+# schedule stopped firing) instead of retrying back to identical tokens.
+# Goodput-under-fault gates through the existing 'goodput' token
+# (gen_chaos_goodput_tokens). Shed counts/rates stay INFORMATIONAL by
+# design: shed volume is offered-load policy, not quality — a round that
+# sheds more under a heavier schedule is not a regression ('shed_rate'
+# deliberately matches no gated token).
 _HIGHER_BETTER_TOKENS = (
     'goodput', 'accept_rate', 'hit_rate', 'tok_s', 'mfu_measured',
-    'bw_util_measured', 'promotion_overlap',
+    'bw_util_measured', 'promotion_overlap', 'recoveries',
 )
 
 

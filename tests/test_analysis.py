@@ -207,6 +207,139 @@ class TestDirectFree:
         assert diags == []
 
 
+ENGINE_REL = 'distllm_tpu/generate/engine/_fixture.py'
+
+
+class TestSwallowedException:
+    """swallowed-exception: in engine/server/tier/resilience paths, an
+    ``except`` must re-raise or emit telemetry (ISSUE 15)."""
+
+    def test_silent_pass_flagged(self):
+        diags = run_rules(
+            'def f(x):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    except ValueError:\n'
+            '        pass\n',
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+        )
+        assert rule_ids_of(diags) == ['swallowed-exception']
+        assert diags[0].line == 4
+
+    def test_silent_return_flagged(self):
+        diags = run_rules(
+            'def f(x):\n'
+            '    try:\n'
+            '        return x.go()\n'
+            '    except Exception:\n'
+            '        return None\n',
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+        )
+        assert rule_ids_of(diags) == ['swallowed-exception']
+
+    def test_reraise_clean(self):
+        diags = run_rules(
+            'def f(x):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    except ValueError:\n'
+            '        raise RuntimeError("context")\n',
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+        )
+        assert diags == []
+
+    def test_metric_emission_clean(self):
+        diags = run_rules(
+            'def f(x, m):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    except ValueError:\n'
+            "        m.labels(tier='disk').inc()\n",
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+        )
+        assert diags == []
+
+    def test_log_event_clean(self):
+        diags = run_rules(
+            'def f(x):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    except ValueError as exc:\n'
+            '        log_event(f"failed: {exc}")\n',
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+        )
+        assert diags == []
+
+    def test_flight_record_clean(self):
+        diags = run_rules(
+            'def f(self, x):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    except ValueError as exc:\n'
+            "        self.flight.record('event', error=repr(exc))\n",
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+        )
+        assert diags == []
+
+    def test_telemetry_note_clean(self):
+        diags = run_rules(
+            'def f(self, x):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    except ValueError as exc:\n'
+            "        self.telemetry['fallback'] = repr(exc)\n",
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+        )
+        assert diags == []
+
+    def test_out_of_scope_path_exempt(self):
+        # The rule is scoped to serving-critical paths; ordinary library
+        # modules keep their idioms.
+        diags = run_rules(
+            'def f(x):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    except ValueError:\n'
+            '        pass\n',
+            ['swallowed-exception'],
+        )
+        assert diags == []
+
+    def test_suppressed(self):
+        diags = run_rules(
+            'def f(x):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    # distlint: disable=swallowed-exception -- membership probe\n'
+            '    except ValueError:\n'
+            '        pass\n',
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+        )
+        assert diags == []
+
+    def test_unused_suppression_flagged(self):
+        diags = run_rules(
+            'def f(x):\n'
+            '    try:\n'
+            '        x.go()\n'
+            '    # distlint: disable=swallowed-exception -- stale\n'
+            '    except ValueError:\n'
+            '        raise\n',
+            ['swallowed-exception'],
+            rel=ENGINE_REL,
+            audit=True,
+        )
+        assert rule_ids_of(diags) == [SUPPRESSION_UNUSED]
+
+
 # ------------------------------------------------------------ catalog rules
 class TestMetricNameCatalog:
     def test_adhoc_registration_flagged(self):
@@ -478,7 +611,9 @@ class TestHostSyncInHotPath:
         src = SourceFile.from_path(REPO / engine_rel, REPO)
         hot = {q for q, _ in rule._hot_functions(src)}
         assert 'LLMEngine._dispatch_window' in hot
-        assert 'LLMEngine._run_to_completion.<locals>.process_one' in hot
+        # process_one moved with the loop body when _run_to_completion
+        # grew its crash-domain recovery wrapper (ISSUE 15).
+        assert 'LLMEngine._serve_pipelined.<locals>.process_one' in hot
 
 
 class TestTracedPythonBranch:

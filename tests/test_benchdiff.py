@@ -151,3 +151,58 @@ def test_library_surface_matches_cli():
     assert benchdiff.gate_direction('gen_tier_spills') is None
     assert benchdiff.gate_direction('gen_tier_promotions') is None
     assert benchdiff.gate_direction('gen_tier_spilled_blocks') is None
+
+
+def test_gen_chaos_gate_directions():
+    """ISSUE 15: goodput-under-fault and recoveries gate higher-better;
+    shed metrics stay informational (shed volume is offered-load policy,
+    not quality)."""
+    assert benchdiff.gate_direction('gen_chaos_goodput_tokens') == 'higher'
+    assert benchdiff.gate_direction('gen_chaos_recoveries') == 'higher'
+    assert benchdiff.gate_direction('gen_chaos_tok_s') == 'higher'
+    assert benchdiff.gate_direction('gen_chaos_shed_rate') is None
+    assert benchdiff.gate_direction('gen_chaos_shed_requests') is None
+    assert benchdiff.gate_direction('gen_chaos_retries') is None
+    assert benchdiff.gate_direction('gen_chaos_quarantined') is None
+    assert benchdiff.gate_direction('gen_chaos_faults_injected') is None
+
+
+def test_gen_chaos_regression_trips_gate(tmp_path):
+    """A CPU-smoke-shaped gen_chaos fragment: dropped recoveries and
+    goodput trip the gate; a shed-rate swing alone does not."""
+    prior = {
+        'n': 7, 'rc': 0,
+        'parsed': {
+            'gen_chaos_goodput_tokens': 226.0,
+            'gen_chaos_recoveries': 2.0,
+            'gen_chaos_shed_rate': 0.10,
+        },
+    }
+    ok_current = {
+        'n': 8, 'rc': 0,
+        'parsed': {
+            'gen_chaos_goodput_tokens': 230.0,
+            'gen_chaos_recoveries': 2.0,
+            'gen_chaos_shed_rate': 0.90,  # informational: never gated
+        },
+    }
+    bad_current = {
+        'n': 8, 'rc': 0,
+        'parsed': {
+            'gen_chaos_goodput_tokens': 150.0,  # -34%
+            'gen_chaos_recoveries': 0.0,        # faults stopped surviving
+            'gen_chaos_shed_rate': 0.10,
+        },
+    }
+    (tmp_path / 'prior.json').write_text(json.dumps(prior))
+    (tmp_path / 'ok.json').write_text(json.dumps(ok_current))
+    (tmp_path / 'bad.json').write_text(json.dumps(bad_current))
+
+    proc = _run(tmp_path / 'prior.json', tmp_path / 'ok.json')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    proc = _run(tmp_path / 'prior.json', tmp_path / 'bad.json')
+    assert proc.returncode == 1
+    assert 'gen_chaos_goodput_tokens' in proc.stdout
+    assert 'gen_chaos_recoveries' in proc.stdout
+    assert 'gen_chaos_shed_rate' not in proc.stdout.split('regression')[-1]

@@ -89,18 +89,17 @@ def test_chat_inspect_command(tmp_path):
     registry().clear()
 
 
-@pytest.fixture
-def chat_server_client(tmp_path):
-    aiohttp = pytest.importorskip('aiohttp')
+def _start_chat_server(config: ChatAppConfig):
+    """Boot ``build_app(config)`` on a free port in a daemon thread;
+    returns ``(base_url, stop)``. Shared by the fixture and the tests
+    that need their own server state (drain is one-way per process)."""
+    pytest.importorskip('aiohttp')
     import socket
 
     from aiohttp import web
 
     from distllm_tpu.chat_server import build_app
 
-    config = ChatAppConfig(
-        generator_config={'name': 'fake', 'response_template': 'server says: {prompt}', 'max_prompt_chars': 2000}
-    )
     app = build_app(config)
 
     with socket.socket() as s:
@@ -134,8 +133,22 @@ def chat_server_client(tmp_path):
             break
         except Exception:
             time.sleep(0.1)
-    yield f'http://127.0.0.1:{port}'
-    loop_holder['loop'].call_soon_threadsafe(loop_holder['loop'].stop)
+
+    def stop():
+        loop_holder['loop'].call_soon_threadsafe(loop_holder['loop'].stop)
+
+    return f'http://127.0.0.1:{port}', stop
+
+
+@pytest.fixture
+def chat_server_client(tmp_path):
+    base, stop = _start_chat_server(
+        ChatAppConfig(
+            generator_config={'name': 'fake', 'response_template': 'server says: {prompt}', 'max_prompt_chars': 2000}
+        )
+    )
+    yield base
+    stop()
 
 
 def test_chat_server_endpoints(chat_server_client):
@@ -416,3 +429,113 @@ def test_chat_server_xprof_endpoint(chat_server_client, tmp_path, monkeypatch):
     assert body['state']['captures_total'] >= 1
     # Bad input -> 400, never a capture.
     assert requests.get(f'{base}/debug/xprof?seconds=x').status_code == 400
+
+
+# ------------------------------------------- resilience surface (ISSUE 15)
+def test_chat_server_drain_lifecycle():
+    """POST /drain: stop admitting (503 + Retry-After on completions),
+    flip /health to not-ready — the readiness signal the multi-replica
+    router polls (docs/resilience.md "Drain lifecycle")."""
+    import requests
+
+    base, stop = _start_chat_server(
+        ChatAppConfig(generator_config={'name': 'fake'})
+    )
+    try:
+        health = requests.get(f'{base}/health')
+        assert health.status_code == 200
+        body = health.json()
+        assert body['ready'] is True and body['draining'] is False
+
+        # A completion still serves before the drain.
+        ok = requests.post(
+            f'{base}/v1/chat/completions',
+            json={'messages': [{'role': 'user', 'content': 'hi'}]},
+        )
+        assert ok.status_code == 200
+
+        drained = requests.post(f'{base}/drain', params={'seconds': '0'})
+        assert drained.status_code == 200
+        body = drained.json()
+        assert body['draining'] is True
+        assert body['drained'] is True  # nothing else was in flight
+        assert body['in_flight_remaining'] == 0
+
+        health = requests.get(f'{base}/health')
+        assert health.status_code == 503
+        body = health.json()
+        assert body['status'] == 'draining'
+        assert body['ready'] is False
+
+        refused = requests.post(
+            f'{base}/v1/chat/completions',
+            json={'messages': [{'role': 'user', 'content': 'late'}]},
+        )
+        assert refused.status_code == 503
+        assert refused.headers['Retry-After']
+        assert refused.json()['error']['type'] == 'draining'
+
+        # Bad drain inputs are 400s, not crashes.
+        assert requests.post(
+            f'{base}/drain', params={'seconds': 'nan'}
+        ).status_code == 400
+    finally:
+        stop()
+
+
+def test_chat_server_drain_metrics_and_ready_gauge():
+    import requests
+
+    from distllm_tpu.observability import instruments
+
+    base, stop = _start_chat_server(
+        ChatAppConfig(generator_config={'name': 'fake'})
+    )
+    try:
+        requests.post(f'{base}/drain', params={'seconds': '0'})
+        shed_before = instruments.RESILIENCE_SHED.labels(
+            reason='draining'
+        ).value
+        requests.post(
+            f'{base}/v1/chat/completions',
+            json={'messages': [{'role': 'user', 'content': 'x'}]},
+        )
+        assert instruments.RESILIENCE_SHED.labels(
+            reason='draining'
+        ).value == shed_before + 1
+        metrics = requests.get(f'{base}/metrics').text
+        assert 'distllm_server_ready 0' in metrics
+        assert 'distllm_resilience_shed_requests_total' in metrics
+    finally:
+        stop()
+
+
+def test_chat_server_overload_returns_429_with_retry_after():
+    """EngineOverloaded from the generate path (SLO-aware admission
+    shedding) surfaces as 429 + an honest Retry-After header."""
+    import requests
+
+    base, stop = _start_chat_server(
+        ChatAppConfig(
+            generator_config={'name': 'fake', 'overload_every': 2}
+        )
+    )
+    try:
+        payload = {'messages': [{'role': 'user', 'content': 'hello'}]}
+        first = requests.post(f'{base}/v1/chat/completions', json=payload)
+        assert first.status_code == 200
+        second = requests.post(
+            f'{base}/v1/chat/completions', json=payload,
+            headers={'X-Request-Id': 'shed-me-1'},
+        )
+        assert second.status_code == 429
+        assert second.headers['Retry-After'] == '3'
+        assert second.headers['X-Request-Id'] == 'shed-me-1'
+        body = second.json()
+        assert body['error']['type'] == 'overloaded'
+        assert body['error']['predicted_ttft_s'] > 0
+        # The server recovered: the next request serves again.
+        third = requests.post(f'{base}/v1/chat/completions', json=payload)
+        assert third.status_code == 200
+    finally:
+        stop()

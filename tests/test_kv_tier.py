@@ -325,3 +325,115 @@ def test_tier_metrics_exported(tmp_path):
         'distllm_prefix_tier_dropped_blocks_total',
     ):
         assert series in text, series
+
+
+# ------------------------------------------- resilience satellites (ISSUE 15)
+def test_disk_tier_corrupt_kvblock_degrades_to_miss(tmp_path):
+    """A corrupt or truncated .kvblock (bad header, short read) must count
+    a distllm_prefix_tier_errors_total{tier="disk"}, drop the entry, and
+    return None — never raise toward add_request."""
+    from distllm_tpu.observability import instruments as _m
+
+    tier = DiskKVTier(tmp_path, max_bytes=1 << 20)
+    k = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    for i in range(3):
+        assert tier.put(_digest(i), k + i, k * 2)
+
+    def _file(i):
+        return tmp_path / f'{_digest(i).hex()}.kvblock'
+
+    # Three corruption classes: no header line at all, a header that is
+    # not a shape/dtype record, and a body truncated mid-array.
+    _file(0).write_bytes(b'garbage with no newline header')
+    _file(1).write_bytes(b'{"not": "a shape record"}\n1234')
+    payload = _file(2).read_bytes()
+    _file(2).write_bytes(payload[: len(payload) // 2 + 7])
+
+    errors_before = _m.PREFIX_TIER_ERRORS.labels(tier='disk').value
+    for i in range(3):
+        assert tier.get(_digest(i)) is None
+    assert (
+        _m.PREFIX_TIER_ERRORS.labels(tier='disk').value == errors_before + 3
+    )
+    # Entries dropped and corrupt files unlinked: the tier self-heals
+    # instead of serving the same corruption forever.
+    assert tier.num_blocks == 0
+    assert not any(_file(i).exists() for i in range(3))
+    # A healthy put/get cycle still works after the corruption storm.
+    assert tier.put(_digest(3), k, k * 2)
+    got_k, _ = tier.get(_digest(3))
+    np.testing.assert_array_equal(got_k, k)
+
+
+def test_corrupt_disk_tier_falls_through_to_cold_prefill(tmp_path):
+    """Engine-level regression: every .kvblock corrupted behind the
+    engine's back — add_request's tier walk plans promotions, the loads
+    fail, and the requests cold-prefill to bit-exact tokens with the
+    error counter as the only trace (never an exception)."""
+    from distllm_tpu.observability import instruments as _m
+
+    tier_dir = tmp_path / 'tier'
+    # host_kv_tier_bytes=1: every spill is immediately evicted from the
+    # host pool (write-through has already persisted it), so the DISK
+    # tier is the only place warm prefixes survive — exactly the restart
+    # topology the corruption must not break.
+    cfg, params, engine = _tiny_engine(
+        host_kv_tier_bytes=1, disk_kv_tier_dir=str(tier_dir), **TIER_POOL
+    )
+    first = engine.generate_ids([PROMPT_A], GREEDY)[0]
+    engine.generate_ids([PROMPT_B], GREEDY)  # evicts A's blocks -> disk
+    files = list(tier_dir.glob('*.kvblock'))
+    assert files
+    for path in files:
+        path.write_bytes(b'corrupt')
+    errors_before = _m.PREFIX_TIER_ERRORS.labels(tier='disk').value
+    got = engine.generate_ids([PROMPT_A], GREEDY)[0]
+    assert got == first == _dense_greedy(cfg, params, PROMPT_A, 4)
+    assert _m.PREFIX_TIER_ERRORS.labels(tier='disk').value > errors_before
+    assert not engine._stats.get('tier_promotions')
+
+
+def test_disk_tier_warm_restart_bit_exact(tmp_path):
+    """ISSUE 15 satellite: kill an engine mid-run, rebuild over the same
+    disk_kv_tier_dir, and the fresh engine promotes the previous
+    process's spills — warm prefix coverage and bit-exact tokens versus
+    an unkilled run."""
+    from distllm_tpu.observability import instruments as _m
+
+    tier_dir = str(tmp_path / 'tier')
+    kwargs = dict(
+        host_kv_tier_bytes=64 << 20, disk_kv_tier_dir=tier_dir, **TIER_POOL
+    )
+    cfg, params, a = _tiny_engine(**kwargs)
+    first = a.generate_ids([PROMPT_A], GREEDY)[0]
+    # Kill mid-run: admit PROMPT_B (its admission pressure spills A's
+    # cached blocks, write-through persisting them), take a couple of
+    # engine steps, then abandon the process state with no graceful
+    # flush — exactly what a SIGKILL leaves behind.
+    a.add_request(list(PROMPT_B), GREEDY)
+    a.step()
+    a.step()
+    a.shutdown()
+    assert list((tmp_path / 'tier').glob('*.kvblock'))
+
+    disk_promos_before = _m.PREFIX_TIER_PROMOTIONS.labels(
+        tier='disk'
+    ).value
+    _, _, b = _tiny_engine(**kwargs)  # fresh process over the same dir
+    got = b.generate_ids([PROMPT_A], GREEDY)[0]
+    # Unkilled reference: same engine shape, fresh tier dir.
+    _, _, ref = _tiny_engine(
+        host_kv_tier_bytes=64 << 20,
+        disk_kv_tier_dir=str(tmp_path / 'ref'),
+        **TIER_POOL,
+    )
+    want = ref.generate_ids([PROMPT_A], GREEDY)[0]
+    assert got == want == first == _dense_greedy(cfg, params, PROMPT_A, 4)
+    # Warm restart is real: the rebuilt engine promoted spilled blocks
+    # from disk (prefill covered cached tokens) instead of cold-running.
+    assert b._stats.get('tier_promotions', 0) >= 1
+    assert b._stats.get('prefix_hit_tokens', 0) > 0
+    assert (
+        _m.PREFIX_TIER_PROMOTIONS.labels(tier='disk').value
+        > disk_promos_before
+    )

@@ -31,7 +31,7 @@ from distllm_tpu.analysis.core import SYNTAX_ERROR
 
 REPO = Path(__file__).resolve().parent.parent
 
-# All eleven registered rules, enforced in tier-1. Pinned by id so a rule
+# All twelve registered rules, enforced in tier-1. Pinned by id so a rule
 # silently falling out of the registry fails here instead of passing
 # vacuously.
 EXPECTED_RULES = frozenset(
@@ -47,6 +47,7 @@ EXPECTED_RULES = frozenset(
         'traced-python-branch',
         'lock-discipline',
         'nondeterminism-in-dispatch',
+        'swallowed-exception',
     }
 )
 
