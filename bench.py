@@ -33,9 +33,10 @@ record and a global wall-clock deadline:
   publishes every completed stage;
 - stages run cheapest-first (embed → embed_q → gen → gen_prefix →
   gen_mixed → gen_spec → gen_kernel → gen_load → gen_tier → gen_chaos →
-  gen_q: embed warmups are minutes, ``gen_prefix``/``gen_mixed``/
-  ``gen_spec``/``gen_load``/``gen_tier``/``gen_chaos`` and
-  ``gen_kernel``'s XLA arm reuse ``gen``'s compile cache, and int8
+  gen_kvq → gen_q: embed warmups are minutes, ``gen_prefix``/
+  ``gen_mixed``/``gen_spec``/``gen_load``/``gen_tier``/``gen_chaos`` and
+  ``gen_kernel``'s XLA arm reuse ``gen``'s compile cache, ``gen_kvq``
+  compiles its own block_size=32 bf16/int8 shapes, and int8 weight-quant
   ``gen_q``'s cold warmup — 22–45 min in round 4 — goes last);
 - a failing or SIGTERM'd stage dumps a debug bundle (flight ring, metrics,
   traces — ``observability.dump_debug_bundle``) so a dead stage still
@@ -1738,6 +1739,210 @@ def _stage_gen_chaos() -> dict:
     return out
 
 
+def _stage_gen_kvq() -> dict:
+    """Quantized-KV-cache A/B (docs/serving.md "Quantized KV cache"): the
+    SAME staggered greedy workload (the gen_mixed shape — shared-prefix
+    repeats, staggered finish budgets) through a bf16-KV arm and an
+    int8-KV arm of ``EngineConfig.kv_cache_dtype``, same model weights,
+    same pool geometry.
+
+    The contract this stage checks and records:
+
+    - tok/s per arm (``gen_kvq_bf16_tok_s`` / ``gen_kvq_int8_tok_s``)
+      and their ratio (``gen_kvq_speedup``);
+    - MEASURED bandwidth utilization per arm (mean of the per-window
+      ``bw_util_measured`` flight fields — ``compiled.cost_analysis()``
+      truth, docs/observability.md) plus each arm's measured
+      per-decode-dispatch bytes (``*_decode_bytes_accessed``) and exact
+      KV pool bytes (``*_kv_pool_bytes``): the int8 pool is ~half the
+      bf16 pool and the measured dispatch bytes must drop by the KV
+      share — roofline EVIDENCE, not a modelled claim;
+    - admission capacity at fixed pool bytes
+      (``gen_kvq_int8_capacity_blocks``): how many int8 blocks — data
+      plus their per-block scales — the bf16 arm's HBM budget would
+      hold, i.e. the extra sequences the same chip admits;
+    - the ACCURACY arm: ``gen_kvq_greedy_match``, the fraction of int8
+      greedy tokens matching the bf16 stream position-for-position over
+      the paired requests. Divergence is RECORDED, never asserted away;
+      scripts/benchdiff.py gates the fraction higher-better (the
+      'greedy_match' token), so a lossier compression trips the
+      trajectory gate exactly like a throughput fall.
+
+    A failed int8 arm records ``gen_kvq_error`` — unlike gen_kernel's
+    fast arm, the quantized pool is the stage's whole subject, so its
+    absence IS a stage failure. ``DISTLLM_BENCH_KVQ=0`` skips (default
+    on).
+    """
+    import jax
+    import numpy as np
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.models import mistral
+    from distllm_tpu.observability.flight import get_flight_recorder
+
+    prefix = 'gen_kvq_'
+    if os.environ.get('DISTLLM_BENCH_KVQ', '1') in ('', '0'):
+        return {f'{prefix}skipped': 'DISTLLM_BENCH_KVQ=0'}
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='bfloat16',
+        )
+        max_num_seqs, num_blocks = 4, 80
+        n_prompts, prompt_lo, prompt_hi = 10, 8, 48
+        out_lo, out_hi = 4, 24
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+        max_num_seqs, num_blocks = 32, 356
+        n_prompts, prompt_lo, prompt_hi = 64, 32, 192
+        out_lo, out_hi = 16, 96
+
+    rng = np.random.default_rng(0)
+    # The gen_mixed staggered shape: every third prompt repeats a shared
+    # prefix (RAG/MCQA), finish budgets stagger so slots free mid-stream
+    # and decode windows carry mixed work — the serving regime where KV
+    # bandwidth, not weights, is the decode bottleneck.
+    shared = list(rng.integers(1, model_cfg.vocab_size, size=32))
+    prompts = []
+    for i, n in enumerate(rng.integers(prompt_lo, prompt_hi, size=n_prompts)):
+        tail = list(rng.integers(1, model_cfg.vocab_size, size=int(n)))
+        prompts.append(shared + tail if i % 3 == 0 else tail)
+    budgets = [int(n) for n in rng.integers(out_lo, out_hi, size=n_prompts)]
+
+    def run_arm(kv_dtype: str) -> dict:
+        # block_size=32 (not the gen-stage-usual 16): the int8 sublane
+        # tile (ops.paged_attention.kv_sublane_tile) — BOTH arms use it
+        # so the A/B compares KV dtype, never pool geometry, and the
+        # int8 arm stays Pallas-eligible on TPU.
+        engine_cfg = EngineConfig(
+            block_size=32,
+            num_blocks=num_blocks,
+            max_num_seqs=max_num_seqs,
+            max_model_len=512,
+            decode_steps=16,
+            pipeline_depth=2,
+            sampling_top_window=64,
+            enable_prefix_cache=True,
+            prefill_chunk_tokens=256,
+            kv_cache_dtype=kv_dtype,
+        )
+        engine, fallback_reason = _build_engine_with_fallback(
+            model_cfg,
+            engine_cfg,
+            lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+            [[1, 2, 3]],
+            SamplingParams(temperature=0.0, max_tokens=2),
+        )
+        try:
+            flight_before = len(get_flight_recorder().snapshot())
+            rids = [
+                engine.add_request(
+                    p, SamplingParams(temperature=0.0, max_tokens=n)
+                )
+                for p, n in zip(prompts, budgets)
+            ]
+            start = time.perf_counter()
+            seen: dict = {rid: [] for rid in rids}
+            while engine.has_unfinished:
+                for rid, tok in engine.step():
+                    seen[rid].append(tok)
+            elapsed = time.perf_counter() - start
+            n_tokens = sum(len(v) for v in seen.values())
+            records = get_flight_recorder().snapshot()[flight_before:]
+            measured_bw = [
+                r['bw_util_measured']
+                for r in records
+                if 'bw_util_measured' in r
+            ]
+            decode_cost = engine.measured_costs().get('decode', {})
+            return {
+                'tokens': [seen[rid] for rid in rids],
+                'tok_s': round(n_tokens / elapsed, 2),
+                'resolved_backend': engine.telemetry['attn_backend'],
+                'kv_cache_dtype': engine.telemetry['kv_cache_dtype'],
+                'bw_util_measured': (
+                    round(float(np.mean(measured_bw)), 5)
+                    if measured_bw else None
+                ),
+                'decode_bytes_accessed': decode_cost.get('bytes_accessed'),
+                'kv_pool_bytes': int(engine.kv.hbm_bytes),
+                'fallback_reason': fallback_reason,
+            }
+        finally:
+            engine.shutdown()
+
+    cache_before = _cache_entries()
+    t0 = time.perf_counter()
+    bf16 = run_arm('bf16')
+    try:
+        q8 = run_arm('int8')
+        q8_error = None
+    except Exception as exc:
+        q8, q8_error = None, f'int8 arm: {exc!r}'[:400]
+    elapsed_both = time.perf_counter() - t0
+
+    out = {
+        f'{prefix}metric': 'bf16-KV vs int8-KV A/B',
+        f'{prefix}bf16_tok_s': bf16['tok_s'],
+        f'{prefix}bf16_bw_util_measured': bf16['bw_util_measured'],
+        f'{prefix}bf16_decode_bytes_accessed': bf16['decode_bytes_accessed'],
+        f'{prefix}bf16_kv_pool_bytes': bf16['kv_pool_bytes'],
+        f'{prefix}bf16_resolved_backend': bf16['resolved_backend'],
+        f'{prefix}elapsed_both_arms_s': round(elapsed_both, 1),
+        f'{prefix}workload': _workload_fingerprint(
+            {'prompts': [list(map(int, p)) for p in prompts],
+             'budgets': budgets,
+             'engine': {'max_num_seqs': max_num_seqs,
+                        'num_blocks': num_blocks,
+                        'block_size': 32,
+                        'prefill_chunk_tokens': 256}}
+        ),
+        **_cache_fields(prefix, cache_before),
+    }
+    if q8 is not None:
+        # The accuracy arm: position-for-position greedy agreement over
+        # the paired streams. Divergent-length tails count as misses
+        # (max, not min, in the denominator) — an early-stopping stream
+        # is itself a divergence, not a shorter exam.
+        matched = total = 0
+        for a, b in zip(bf16['tokens'], q8['tokens']):
+            total += max(len(a), len(b))
+            matched += sum(1 for x, y in zip(a, b) if x == y)
+        # Admission capacity at FIXED pool bytes: the block count the
+        # bf16 arm's HBM budget funds when each block is int8 data plus
+        # its fp32 per-(block, KV-head) scales.
+        per_block_q8 = q8['kv_pool_bytes'] / num_blocks
+        out.update({
+            f'{prefix}int8_tok_s': q8['tok_s'],
+            f'{prefix}int8_bw_util_measured': q8['bw_util_measured'],
+            f'{prefix}int8_decode_bytes_accessed': (
+                q8['decode_bytes_accessed']
+            ),
+            f'{prefix}int8_kv_pool_bytes': q8['kv_pool_bytes'],
+            f'{prefix}int8_resolved_backend': q8['resolved_backend'],
+            f'{prefix}int8_kv_cache_dtype': q8['kv_cache_dtype'],
+            f'{prefix}kv_pool_bytes_ratio': round(
+                q8['kv_pool_bytes'] / max(bf16['kv_pool_bytes'], 1), 4
+            ),
+            f'{prefix}bf16_capacity_blocks': num_blocks,
+            f'{prefix}int8_capacity_blocks': int(
+                bf16['kv_pool_bytes'] // per_block_q8
+            ),
+            f'{prefix}speedup': round(
+                q8['tok_s'] / max(bf16['tok_s'], 1e-9), 3
+            ),
+            f'{prefix}greedy_match': round(matched / max(total, 1), 4),
+        })
+    else:
+        out[f'{prefix}error'] = q8_error
+    if bf16['fallback_reason'] or (q8 and q8['fallback_reason']):
+        out[f'{prefix}attn_fallback_reason'] = (
+            bf16['fallback_reason'] or q8['fallback_reason']
+        )
+    return out
+
+
 def _stage_gen() -> dict:
     return _run_gen(None, 'gen_')
 
@@ -1776,7 +1981,7 @@ def _chip_peak_flops(device) -> float | None:
 # expensive coverage first, never the headline metrics.
 STAGE_ORDER = (
     'embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_spec',
-    'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos', 'gen_q',
+    'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos', 'gen_kvq', 'gen_q',
 )
 NOMINAL_BUDGET_S = {
     'embed': 1200.0,
@@ -1789,11 +1994,12 @@ NOMINAL_BUDGET_S = {
     'gen_load': 2700.0,
     'gen_tier': 2700.0,
     'gen_chaos': 2700.0,
+    'gen_kvq': 2700.0,
     'gen_q': 2700.0,
 }
 GEN_STAGES = frozenset(
     {'gen', 'gen_q', 'gen_prefix', 'gen_mixed', 'gen_spec', 'gen_kernel',
-     'gen_load', 'gen_tier', 'gen_chaos'}
+     'gen_load', 'gen_tier', 'gen_chaos', 'gen_kvq'}
 )
 # Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
 # stages stop with ~5 min to spare even if the guess is exact, and the
@@ -2041,6 +2247,7 @@ def _run_stage_entry(stage: str) -> None:
         'gen_load': _stage_gen_load,
         'gen_tier': _stage_gen_tier,
         'gen_chaos': _stage_gen_chaos,
+        'gen_kvq': _stage_gen_kvq,
     }
     watchdog = None
     watchdog_s = float(os.environ.get('DISTLLM_BENCH_WATCHDOG_S', '300') or 0)
@@ -2066,6 +2273,7 @@ def main() -> None:
         choices=[
             'embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix', 'gen_mixed',
             'gen_spec', 'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos',
+            'gen_kvq',
         ],
     )
     args = parser.parse_args()

@@ -271,6 +271,26 @@ def _fixpoint_derived_names(fn: ast.AST, expr_is_derived) -> set[str]:
     return derived
 
 
+def _isinstance_arg_names(expr: ast.AST) -> set[ast.AST]:
+    """``ast.Name`` nodes appearing inside ``isinstance(...)`` arguments.
+    ``isinstance`` inspects the PYTHON type of its operand — for traced
+    code that is the pytree-container class (the ``QuantizedKV``-vs-bare-
+    array dispatch in ops/paged_attention.py), resolved at trace time and
+    never concretizing a tracer — so these occurrences are static exactly
+    like ``.shape``/``.dtype`` attribute reads."""
+    names: set[ast.AST] = set()
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Call)
+            and _dotted_root(node.func) == 'isinstance'
+        ):
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub)
+    return names
+
+
 def _jnp_derived_names(fn: ast.AST) -> set[str]:
     """Names bound (directly or transitively) from ``jnp.*``/``lax.*``/
     ``jax.*`` expressions inside ``fn``. Parameters are deliberately NOT
@@ -278,7 +298,10 @@ def _jnp_derived_names(fn: ast.AST) -> set[str]:
     code is normal; only locally device-derived values are tracked."""
 
     def expr_is_derived(expr: ast.AST, derived: set[str]) -> bool:
-        statics = _static_attr_leaves(expr)
+        # isinstance results are static bools (trace-time type dispatch),
+        # so `quantized = isinstance(cache, QuantizedKV)` must not mark
+        # `quantized` as device-derived.
+        statics = _static_attr_leaves(expr) | _isinstance_arg_names(expr)
         for node in ast.walk(expr):
             if isinstance(node, ast.Call):
                 root = _dotted_root(node.func)
@@ -298,8 +321,9 @@ def _jnp_derived_names(fn: ast.AST) -> set[str]:
 def _test_uses_traced_value(test: ast.AST, derived: set[str]) -> bool:
     """True when evaluating ``test`` concretizes a tracked array: either
     a direct ``jnp.*``/``lax.*`` call, or a tracked name used as a value
-    (not merely via a static attribute like ``.shape``)."""
-    static_bases = _static_attr_leaves(test)
+    (not merely via a static attribute like ``.shape`` or an
+    ``isinstance`` type dispatch)."""
+    static_bases = _static_attr_leaves(test) | _isinstance_arg_names(test)
     for node in ast.walk(test):
         if isinstance(node, ast.Call):
             root = _dotted_root(node.func)
@@ -446,6 +470,12 @@ class HostSyncInHotPathRule(Rule):
             'LLMEngine._begin_promotion',
             'LLMEngine._finish_promotions',
             'LLMEngine._evict_cached_blocks',
+            # Quantize-at-write landing site (docs/serving.md "Quantized
+            # KV cache"): the prefill scatter that computes per-block
+            # absmax scales on device. Entirely jit-traced — any host
+            # sync added here would fire per admitted prefill.
+            '_write_prefill_all_layers',
+            '_write_prefill_all_layers_quantized',
         ),
         'distllm_tpu/models/mistral.py': (
             'mixed_window',
@@ -453,6 +483,21 @@ class HostSyncInHotPathRule(Rule):
             'decode_step',
             'decode_loop',
             'prefill_paged',
+        ),
+        # The quantize-at-write / rescale-on-append path (docs/serving.md
+        # "Quantized KV cache"): these run inside every traced serving
+        # dispatch that touches an int8 pool, so a stray sync here
+        # serializes every window — same contract as the engine loop.
+        'distllm_tpu/ops/paged_attention.py': (
+            'quantize_kv_rows',
+            '_rescale_int8_blocks',
+            '_gather_kv_blocks',
+            'write_token_kv',
+            '_write_token_kv_quantized',
+            'write_chunk_kv',
+            '_write_chunk_kv_quantized',
+            'write_prefill_kv',
+            '_write_prefill_kv_quantized',
         ),
     }
 

@@ -61,6 +61,11 @@ from distllm_tpu.observability.startup import (
     get_compile_watcher,
     record_backend_init,
 )
+from distllm_tpu.ops.paged_attention import (
+    KV_QUANT_MAX,
+    QuantizedKV,
+    quantize_kv_rows,
+)
 from distllm_tpu.ops.sampling import sample_tokens
 from distllm_tpu.resilience.admission import (
     EngineLoadView,
@@ -201,6 +206,19 @@ class EngineConfig(BaseConfig):
     # RESOLVED value is surfaced in engine telemetry and the
     # distllm_engine_attn_backend_info metric.
     attn_backend: str = 'xla'  # 'auto' | 'xla' | 'pallas' | 'interpret'
+    # Storage dtype of the paged KV pool (docs/serving.md "Quantized KV
+    # cache"). 'auto' (default) keeps today's behavior bit-exactly: the
+    # pool stores the model compute dtype — the structural baseline, the
+    # spec_draft_source='none' discipline applied to KV storage. 'bf16' /
+    # 'fp32' pin an explicit float pool (useful for A/Bs against 'auto');
+    # 'int8' stores K/V as int8 with per-block-per-KV-head symmetric fp32
+    # scales, quantized at write time and dequantized fused into the
+    # attention kernels' per-band KV loads — half the bytes per paged-
+    # attention dispatch and per tier spill/promotion. int8 raises the
+    # Pallas sublane tile to 32, so the default block_size=16 serves int8
+    # through the XLA backend ('auto' falls back quietly; an explicit
+    # 'pallas' pin raises with the block_size=32 fix).
+    kv_cache_dtype: str = 'auto'  # 'auto' | 'bf16' | 'fp32' | 'int8'
     quantization: str | None = None  # None | 'int8' | 'nf4' (weight-only)
     # Tokens generated per decode dispatch (the fused lax.scan window).
     # 1 restores per-token dispatch; >1 amortizes dispatch+sync latency.
@@ -485,6 +503,16 @@ class EngineConfig(BaseConfig):
             )
         return v
 
+    @field_validator('kv_cache_dtype')
+    @classmethod
+    def _known_kv_cache_dtype(cls, v: str) -> str:
+        if v not in ('auto', 'bf16', 'fp32', 'int8'):
+            raise ValueError(
+                "kv_cache_dtype must be 'auto', 'bf16', 'fp32', or "
+                f"'int8', got {v!r}"
+            )
+        return v
+
 
 class LLMEngine:
     """Drives a Mistral-family decoder with paged KV + continuous batching.
@@ -547,6 +575,14 @@ class LLMEngine:
             kv_sharding = NamedSharding(mesh, P(None, None, None, 'model'))
             self._replicated = NamedSharding(mesh, P())
 
+        # Resolve the KV storage dtype ONCE (the attn/qmm pinning
+        # pattern): 'auto' stores the model compute dtype — bit-exact
+        # with the pre-kv_cache_dtype engine; 'int8' switches the pool to
+        # QuantizedKV storage (docs/serving.md "Quantized KV cache").
+        kv_pool_dtype = {
+            'bf16': 'bfloat16', 'fp32': 'float32', 'int8': 'int8',
+        }.get(cfg.kv_cache_dtype, model_cfg.dtype)
+
         # Lazy: the pool is materialized only after the (transient-heavy)
         # weight-layout migration below, so migration headroom isn't
         # squeezed by an idle 1-6 GiB of zeros.
@@ -556,7 +592,7 @@ class LLMEngine:
             block_size=cfg.block_size,
             num_kv_heads=model_cfg.num_kv_heads,
             head_dim=model_cfg.head_size,
-            dtype=model_cfg.dtype,
+            dtype=kv_pool_dtype,
             sharding=kv_sharding,
             lazy=True,
         )
@@ -676,15 +712,40 @@ class LLMEngine:
         # CI-covered head dims and the always-available XLA baseline
         # everywhere else, and a config change after construction can
         # never re-route live dispatches.
-        from distllm_tpu.ops.paged_attention import resolve_attn_backend
+        from distllm_tpu.ops.paged_attention import (
+            kv_sublane_tile,
+            resolve_attn_backend,
+        )
 
         attn_backend = resolve_attn_backend(
             cfg.attn_backend, model,
             # 'auto' eligibility includes the kernel's DMA contract on the
             # KV block geometry — a config the kernel would reject must
-            # resolve to XLA, never trace into a ValueError.
-            block_size=cfg.block_size, kv_dtype=model.dtype,
+            # resolve to XLA, never trace into a ValueError. The STORAGE
+            # dtype decides the sublane tile: an int8 pool needs
+            # block_size % 32 == 0, so int8 + the default block_size=16
+            # quietly keeps the XLA tier under 'auto'.
+            block_size=cfg.block_size, kv_dtype=kv_pool_dtype,
         )
+        _sublane = kv_sublane_tile(kv_pool_dtype)
+        if (
+            jnp.dtype(kv_pool_dtype) == jnp.dtype(jnp.int8)
+            and attn_backend in ('pallas', 'interpret')
+            and cfg.block_size % _sublane
+        ):
+            # Explicit kernel pin on an ineligible int8 KV geometry: fail
+            # at construction with the fix, not mid-warmup from the
+            # kernel's trace-time guard (the head-dim guard's discipline).
+            # Full-precision pools keep their seed behavior — interpret
+            # mode runs any block size, and 'auto' already routes
+            # compiled-TPU ineligibility to XLA via resolve_attn_backend.
+            raise ValueError(
+                f'attn_backend={attn_backend!r} needs block_size % '
+                f'{_sublane} == 0 for {jnp.dtype(kv_pool_dtype).name} KV '
+                f'caches, got block_size={cfg.block_size}; use '
+                f'block_size={_sublane} (EngineConfig.block_size) or '
+                "attn_backend='xla'"
+            )
         if mesh is not None and attn_backend != 'xla':
             # GSPMD cannot partition the ragged pallas_call over the
             # kv-head-sharded cache planes (the qmm 'pallas' TP rule,
@@ -711,10 +772,11 @@ class LLMEngine:
 
             logging.getLogger(__name__).warning(
                 "attn_backend='auto' resolved to the XLA paged-attention "
-                'path on a TPU (head_dim %d, block_size %d, tensor '
-                'parallel: %s) — the fused Pallas kernel is not eligible '
-                'for this config',
-                model.head_size, cfg.block_size, mesh is not None,
+                'path on a TPU (head_dim %d, block_size %d, kv dtype %s, '
+                'tensor parallel: %s) — the fused Pallas kernel is not '
+                'eligible for this config',
+                model.head_size, cfg.block_size,
+                jnp.dtype(kv_pool_dtype).name, mesh is not None,
             )
 
         # Automatic prefix caching: hash-chain over full prompt blocks,
@@ -749,20 +811,28 @@ class LLMEngine:
         # promotion write-back (scatter of device_put'ed host KV).
         # Block-count dims pad up a pow2 ladder so the jit cache stays
         # O(log max_blocks_per_seq); pad slots index the trash block.
+        # tree.map keeps these pool-container-generic: for a bare-array
+        # pool the maps ARE the direct ops (bit-identical HLO); for a
+        # QuantizedKV pool the int8 data and the fp32 scales both carry
+        # their block axis at axis 1, so one lambda moves both planes —
+        # spills and promotions transport quantized blocks natively,
+        # never through a dequantized copy.
         self._gather_blocks = jax.jit(
-            lambda k, v, idx: (k[:, idx], v[:, idx])
+            lambda k, v, idx: jax.tree.map(lambda c: c[:, idx], (k, v))
         )
         self._write_promoted = jax.jit(
-            lambda k, v, kp, vp, idx: (
-                k.at[:, idx].set(kp.astype(k.dtype)),
-                v.at[:, idx].set(vp.astype(v.dtype)),
+            lambda k, v, kp, vp, idx: jax.tree.map(
+                lambda c, p: c.at[:, idx].set(p.astype(c.dtype)),
+                (k, v), (kp, vp),
             ),
             donate_argnums=(0, 1),
         )
         # Tiny post-scatter slice: fetching ONE element is the only
         # reliable completion barrier on this backend (see _migrate
         # _sync) — the promotion-landed probe.
-        self._probe = jax.jit(lambda a: jnp.ravel(a)[:1])
+        self._probe = jax.jit(
+            lambda a: jnp.ravel(jax.tree.leaves(a)[0])[:1]
+        )
         _max_tables = cfg.max_model_len
 
         def prefill_paged_fn(params, ids, pos, k, v, bt, ctx, tails):
@@ -773,11 +843,13 @@ class LLMEngine:
 
         self._prefill_paged = jax.jit(prefill_paged_fn, donate_argnums=(3, 4))
         # Batched COW: copy shared blocks' K/V (all layers) into the
-        # requests' private copies in one dispatch.
+        # requests' private copies in one dispatch. tree.map for the
+        # same reason as the tier jits above: a quantized source block's
+        # int8 data AND its scale row copy together, so the private copy
+        # stays bit-exact (no requantization on COW).
         self._cow_copy = jax.jit(
-            lambda k, v, src, dst: (
-                k.at[:, dst].set(k[:, src]),
-                v.at[:, dst].set(v[:, src]),
+            lambda k, v, src, dst: jax.tree.map(
+                lambda c: c.at[:, dst].set(c[:, src]), (k, v)
             ),
             donate_argnums=(0, 1),
         )
@@ -877,6 +949,17 @@ class LLMEngine:
             _metrics.ATTN_BACKEND_INFO.labels(backend=_be).set(
                 1.0 if _be == attn_backend else 0.0
             )
+        # Same pattern for the RESOLVED KV storage dtype ('auto' is never
+        # surfaced — what the pool actually stores is): exactly one dtype
+        # label reads 1, so a scrape proves which encoding served.
+        _kv_name = jnp.dtype(kv_pool_dtype).name
+        self.telemetry['kv_cache_dtype'] = _kv_name
+        for _dt in _metrics.KV_CACHE_DTYPE_LABELS:
+            _metrics.KV_CACHE_DTYPE_INFO.labels(dtype=_dt).set(
+                1.0 if _dt == _kv_name else 0.0
+            )
+        if _kv_name not in _metrics.KV_CACHE_DTYPE_LABELS:
+            _metrics.KV_CACHE_DTYPE_INFO.labels(dtype='other').set(1.0)
         if cfg.quantization and hasattr(model, 'qmm_backend'):
             self.telemetry['qmm_backend'] = model.qmm_backend
         if (
@@ -1205,6 +1288,12 @@ class LLMEngine:
         """
         watch = self._compile_watcher
         saved_key = self._key  # sampling stream must not observe warmup
+        # Quantized pools compile their own executables for every phase
+        # that touches KV (the int8 scatter/dequant graphs are different
+        # programs): tag the shape labels so the compile ledger
+        # attributes an int8 warmup to the int8 config, not to a
+        # mysteriously-recompiling float one.
+        qtag = 'q8' if self.kv.quantized else ''
         for bucket in self.prefill_buckets:
             cap = self._prefill_batch_cap(bucket)
             b = 1
@@ -1215,7 +1304,7 @@ class LLMEngine:
                 lengths = np.zeros((b,), np.int32)  # all writes -> trash
                 block_rows = np.zeros((b, self.max_blocks_per_seq), np.int32)
                 with watch.phase(
-                    'prefill', f'b{b}x{bucket}', scope=self._compile_scope
+                    'prefill', f'b{b}x{bucket}{qtag}', scope=self._compile_scope
                 ):
                     logits, k_all, v_all = self._prefill(
                         self.params,
@@ -1240,7 +1329,7 @@ class LLMEngine:
                     # chunks dispatch through prefill_paged): tail_lens 0
                     # routes every write to the trash block.
                     with watch.phase(
-                        'prefill_paged', f'b{b}x{bucket}',
+                        'prefill_paged', f'b{b}x{bucket}{qtag}',
                         scope=self._compile_scope,
                     ):
                         (
@@ -1278,7 +1367,7 @@ class LLMEngine:
             # self-copy. Without this, the first aligned full-cover cache
             # hit pays the compile inside the very TTFT the cache exists
             # to shrink.
-            with watch.phase('cow_copy', 'b1', scope=self._compile_scope):
+            with watch.phase('cow_copy', f'b1{qtag}', scope=self._compile_scope):
                 src_dev, dst_dev = self._put_many(
                     np.zeros((1,), np.int32), np.zeros((1,), np.int32)
                 )
@@ -1296,16 +1385,31 @@ class LLMEngine:
             cap = self._pow2(self.max_blocks_per_seq)
             while npad <= cap:
                 with watch.phase(
-                    'tier_promote', f'n{npad}', scope=self._compile_scope
+                    'tier_promote', f'n{npad}{qtag}',
+                    scope=self._compile_scope,
                 ):
                     idx = np.zeros((npad,), np.int32)
                     zeros = np.zeros(
                         (num_layers, npad, bs_, n_kv, head_dim),
                         dtype=self.kv.dtype,
                     )
-                    k_dev, v_dev, idx_dev = self._put_many(
-                        zeros, zeros, idx
-                    )
+                    if self.kv.quantized:
+                        # Promotion operands for an int8 pool are
+                        # QuantizedKV trees: stage zero scale planes
+                        # beside the zero data so the warmed executable
+                        # matches the serving _begin_promotion shapes.
+                        s_zeros = np.zeros(
+                            (num_layers, npad, n_kv), np.float32
+                        )
+                        k_d, v_d, ks_d, vs_d, idx_dev = self._put_many(
+                            zeros, zeros, s_zeros, s_zeros, idx
+                        )
+                        k_dev = QuantizedKV(k_d, ks_d)
+                        v_dev = QuantizedKV(v_d, vs_d)
+                    else:
+                        k_dev, v_dev, idx_dev = self._put_many(
+                            zeros, zeros, idx
+                        )
                     self.kv.k, self.kv.v = self._write_promoted(
                         self.kv.k, self.kv.v, k_dev, v_dev, idx_dev
                     )
@@ -1320,7 +1424,7 @@ class LLMEngine:
         # Warm the fused decode window: steps_left = 0 freezes every slot,
         # so all KV writes land in the trash block and no state advances.
         with watch.phase(
-            'decode_window', f'b{bsz}x{self.config.decode_steps}',
+            'decode_window', f'b{bsz}x{self.config.decode_steps}{qtag}',
             scope=self._compile_scope,
         ):
             tokens, self.kv.k, self.kv.v, _ = self._decode_window(
@@ -1368,7 +1472,7 @@ class LLMEngine:
                 if bucket > span_bucket:
                     break
                 with watch.phase(
-                    'mixed_window', f'b{bsz}x{bucket}c{cb}',
+                    'mixed_window', f'b{bsz}x{bucket}c{cb}{qtag}',
                     scope=self._compile_scope,
                 ):
                     mixed_tokens, self.kv.k, self.kv.v, _, _ = (
@@ -1412,7 +1516,7 @@ class LLMEngine:
             # trash block; logits/tokens are garbage the host discards.
             span = 1 + self.config.draft_k
             with watch.phase(
-                'spec_window', f'b{bsz}s{span}', scope=self._compile_scope
+                'spec_window', f'b{bsz}s{span}{qtag}', scope=self._compile_scope
             ):
                 spec_tokens, self.kv.k, self.kv.v, _ = self._spec_window(
                     self.params,
@@ -1443,7 +1547,7 @@ class LLMEngine:
                 if bucket > span_bucket:
                     break
                 with watch.phase(
-                    'spec_mixed_window', f'b{bsz}s{span}x{bucket}c{cb}',
+                    'spec_mixed_window', f'b{bsz}s{span}x{bucket}c{cb}{qtag}',
                     scope=self._compile_scope,
                 ):
                     spec_tokens, self.kv.k, self.kv.v, _ = (
@@ -1601,6 +1705,14 @@ class LLMEngine:
                 continue
             if cost is not None:
                 self._measured_costs[kind] = cost
+                bytes_accessed = cost.to_dict().get('bytes_accessed')
+                if bytes_accessed:
+                    # Scrape-visible per-dispatch byte traffic: the KV-
+                    # sensitive roofline numerator (an int8 pool shows as
+                    # the decode/mixed kinds dropping by the KV share).
+                    _metrics.ENGINE_KV_DISPATCH_BYTES.labels(
+                        kind=kind
+                    ).set(float(bytes_accessed))
 
     def measured_costs(self) -> dict[str, dict]:
         """XLA-measured per-dispatch executable cost by window kind
@@ -1991,23 +2103,46 @@ class LLMEngine:
         k_dev, v_dev = self._gather_blocks(
             self.kv.k, self.kv.v, self._put(idx)
         )
+        quantized = isinstance(k_dev, QuantizedKV)
         t_fetch = time.monotonic()
+        ks_host = vs_host = None
         with self._annotate('fetch'):
             # distlint: disable=host-sync-in-hot-path -- the spill tier's ONE designed fetch point: evicted ref==0 blocks must cross to host RAM before their pool blocks are reused, and eviction only fires on pool-pressure shortfalls
-            k_host = np.asarray(k_dev)
+            k_host = np.asarray(k_dev.data if quantized else k_dev)
             # distlint: disable=host-sync-in-hot-path -- second half of the same designed spill fetch (V plane of the one padded gather above)
-            v_host = np.asarray(v_dev)
+            v_host = np.asarray(v_dev.data if quantized else v_dev)
+            if quantized:
+                # distlint: disable=host-sync-in-hot-path -- scale rows of the same designed spill fetch (4 bytes per block per KV head, riding the gather already paid for)
+                ks_host = np.asarray(k_dev.scale)
+                # distlint: disable=host-sync-in-hot-path -- V-side scale rows of the same designed spill fetch
+                vs_host = np.asarray(v_dev.scale)
         fetch_s = time.monotonic() - t_fetch
         for i, (digest, _) in enumerate(entries):
             # Per-block copies: LRU eviction must free blocks one at a
-            # time, which views over the gathered base array cannot.
-            self.kv_tier.put(digest, k_host[:, i].copy(), v_host[:, i].copy())
+            # time, which views over the gathered base array cannot. A
+            # quantized pool spills the int8 blocks AS int8 plus their
+            # scale rows (half the bytes over the host link; bit-exact
+            # on promotion — no dequant/requant round trip).
+            if quantized:
+                self.kv_tier.put(
+                    digest, k_host[:, i].copy(), v_host[:, i].copy(),
+                    ks_host[:, i].copy(), vs_host[:, i].copy(),
+                )
+            else:
+                self.kv_tier.put(
+                    digest, k_host[:, i].copy(), v_host[:, i].copy()
+                )
         self._stats['tier_spills'] += 1
         self._stats['tier_spilled_blocks'] += n
+        spilled_bytes = int(k_host[:, :n].nbytes + v_host[:, :n].nbytes)
+        if quantized:
+            spilled_bytes += int(
+                ks_host[:, :n].nbytes + vs_host[:, :n].nbytes
+            )
         self.flight.record(
             'spill',
             blocks=n,
-            bytes=int(k_host[:, :n].nbytes + v_host[:, :n].nbytes),
+            bytes=spilled_bytes,
             fetch_s=round(fetch_s, 6),
             duration_s=round(time.monotonic() - t_start, 6),
             host_tier_blocks=self.kv_tier.num_blocks,
@@ -2028,11 +2163,27 @@ class LLMEngine:
         request.promo_digests = []
         rid = request.request_id
         bs = self.config.block_size
-        pulled: list[tuple[np.ndarray, np.ndarray]] = []
+        num_layers, _, block_size, n_kv, head_dim = self.kv.shape
+        slice_shape = (num_layers, block_size, n_kv, head_dim)
+        pool_quantized = self.kv.quantized
+        arity = 4 if pool_quantized else 2
+        pulled: list[tuple[np.ndarray, ...]] = []
         for digest in digests:
             kv = self.kv_tier.get(digest)
             if kv is None:
                 break  # tier-evicted since the add_request walk
+            if (
+                len(kv) != arity
+                or kv[0].dtype != self.kv.dtype
+                or kv[0].shape != slice_shape
+            ):
+                # A spill from a different kv_cache_dtype/geometry config
+                # (e.g. bf16 disk files meeting a fresh int8 pool, or the
+                # reverse): payload-shape truth beats index membership —
+                # treat as a miss and cold-prefill rather than scatter
+                # another encoding's bytes into the pool.
+                self._stats['tier_payload_mismatches'] += 1
+                break
             pulled.append(kv)
         if not pulled:
             return False
@@ -2042,16 +2193,22 @@ class LLMEngine:
         nb = request.num_borrowed_blocks
         blocks = self.sched.block_row(rid)[nb : nb + n]
         npad = self._pow2(n)
-        num_layers, _, block_size, n_kv, head_dim = self.kv.shape
         k_host = np.zeros(
             (num_layers, npad, block_size, n_kv, head_dim),
             dtype=pulled[0][0].dtype,
         )
         v_host = np.zeros_like(k_host)
+        ks_host = vs_host = None
+        if pool_quantized:
+            ks_host = np.zeros((num_layers, npad, n_kv), np.float32)
+            vs_host = np.zeros_like(ks_host)
         idx = np.zeros((npad,), np.int32)
-        for i, (k_b, v_b) in enumerate(pulled):
-            k_host[:, i] = k_b
-            v_host[:, i] = v_b
+        for i, entry in enumerate(pulled):
+            k_host[:, i] = entry[0]
+            v_host[:, i] = entry[1]
+            if pool_quantized:
+                ks_host[:, i] = entry[2]
+                vs_host[:, i] = entry[3]
             idx[i] = blocks[i]
         t_host = time.monotonic()
         try:
@@ -2062,7 +2219,18 @@ class LLMEngine:
             # distllm_prefix_tier_errors_total{tier="host"}, never raised
             # into admission.
             self._faults.fail('device_put')
-            k_dev, v_dev, idx_dev = self._put_many(k_host, v_host, idx)
+            if pool_quantized:
+                # Scales stage beside the data planes in the SAME put
+                # batch, then ride _write_promoted's tree.map scatter as
+                # QuantizedKV leaves — promotion is int8-to-int8
+                # bit-exact, scales intact.
+                k_dev, v_dev, ks_dev, vs_dev, idx_dev = self._put_many(
+                    k_host, v_host, ks_host, vs_host, idx
+                )
+                k_dev = QuantizedKV(k_dev, ks_dev)
+                v_dev = QuantizedKV(v_dev, vs_dev)
+            else:
+                k_dev, v_dev, idx_dev = self._put_many(k_host, v_host, idx)
             with self._annotate('promote'):
                 self.kv.k, self.kv.v = self._write_promoted(
                     self.kv.k, self.kv.v, k_dev, v_dev, idx_dev
@@ -4086,10 +4254,14 @@ def _write_prefill_all_layers(
 
     ``block_rows`` is ``[B, R]`` and ``lengths`` ``[B]``; positions at or
     beyond a row's length (padding rows have length 0) write to the
-    reserved trash block 0.
+    reserved trash block 0. A :class:`QuantizedKV` pool quantizes at this
+    write (per-block-per-KV-head absmax over the live rows — full prefill
+    always starts its blocks fresh, so this is single-shot quantization,
+    no rescale chain).
     """
     num_layers, batch, seq_len = k_seq.shape[:3]
-    block_size = k_cache.shape[2]
+    quantized = isinstance(k_cache, QuantizedKV)
+    block_size = (k_cache.data if quantized else k_cache).shape[2]
     positions = jnp.arange(seq_len)[None, :]  # [1, S]
     valid = positions < lengths[:, None]  # [B, S]
     block_ids = jnp.where(
@@ -4100,6 +4272,11 @@ def _write_prefill_all_layers(
     offsets = jnp.where(valid, positions % block_size, 0)
     flat_blocks = block_ids.reshape(-1)
     flat_offsets = offsets.reshape(-1)
+    if quantized:
+        return _write_prefill_all_layers_quantized(
+            k_cache, v_cache, k_seq, v_seq, block_rows, lengths,
+            valid, flat_blocks, flat_offsets,
+        )
     k_flat = k_seq.reshape(num_layers, batch * seq_len, *k_seq.shape[3:])
     v_flat = v_seq.reshape(num_layers, batch * seq_len, *v_seq.shape[3:])
     k_cache = k_cache.at[:, flat_blocks, flat_offsets].set(
@@ -4109,3 +4286,44 @@ def _write_prefill_all_layers(
         v_flat.astype(v_cache.dtype)
     )
     return k_cache, v_cache
+
+
+def _write_prefill_all_layers_quantized(
+    k_cache, v_cache, k_seq, v_seq, block_rows, lengths,
+    valid, flat_blocks, flat_offsets,
+):
+    """Quantized twin of :func:`_write_prefill_all_layers`.
+
+    Every block this scatter touches is freshly owned by its row (full
+    prefill from position 0), so each block's scale is its live rows'
+    absmax / 127 computed in one masked pass — never a running-absmax
+    rescale. Dead rows and dead blocks route to the trash block 0 with a
+    zero scale, and ``quantize_kv_rows``'s guarded denominator keeps the
+    dead branch finite (no NaN may reach a scatter, even into trash).
+    """
+    num_layers, batch, seq_len = k_seq.shape[:3]
+    block_size = k_cache.data.shape[2]
+    nt = -(-seq_len // block_size)  # blocks per row this shape can touch
+    pad = nt * block_size - seq_len
+    live_blk = jnp.arange(nt)[None, :] * block_size < lengths[:, None]
+    phys = jnp.where(live_blk, block_rows[:, :nt], 0)  # [B, nt]
+    flat_phys = phys.reshape(-1)
+
+    def write_one(cache, seq):
+        amax = jnp.max(jnp.abs(seq.astype(jnp.float32)), axis=-1)
+        amax = jnp.where(valid[None, :, :, None], amax, 0.0)
+        blk_amax = jnp.pad(
+            amax, ((0, 0), (0, 0), (0, pad), (0, 0))
+        ).reshape(num_layers, batch, nt, block_size, -1).max(axis=3)
+        new_scale = blk_amax / KV_QUANT_MAX  # [L, B, nt, Nkv]
+        scale = cache.scale.at[:, flat_phys].set(
+            new_scale.reshape(num_layers, batch * nt, -1)
+        )
+        # Each token row quantizes against ITS block's scale.
+        scale_tok = jnp.repeat(new_scale, block_size, axis=2)[:, :, :seq_len]
+        q = quantize_kv_rows(seq, scale_tok)
+        q_flat = q.reshape(num_layers, batch * seq_len, *q.shape[3:])
+        data = cache.data.at[:, flat_blocks, flat_offsets].set(q_flat)
+        return QuantizedKV(data, scale)
+
+    return write_one(k_cache, k_seq), write_one(v_cache, v_seq)

@@ -341,6 +341,15 @@ class DiskKVTier:
     One ``<digest-hex>.kvblock`` file per spilled block (a JSON header
     line carrying shape/dtype, then the raw K bytes followed by the raw V
     bytes — byte-exact for bf16 and every other KV dtype, no pickle).
+    Format version 2 adds a ``version`` field and a ``scales`` entry to
+    the header so quantized (int8) pools spill their per-block scale rows
+    alongside the data: the body becomes K, V, K-scale, V-scale at exact
+    byte offsets computed from the header shapes. Version-less files
+    (pre-int8 spills) still load on the legacy halve-the-body path;
+    an UNKNOWN version counts ``distllm_prefix_tier_errors_total{tier=
+    "disk"}`` and degrades to a miss (cold prefill) exactly like the
+    other corruption paths — a newer process's format must never crash
+    an older reader.
     The digest chain makes the file name self-describing: it identifies
     the ENTIRE token prefix up to and including the block, so a fresh
     engine on the same corpus promotes straight from a previous process's
@@ -435,16 +444,30 @@ class DiskKVTier:
         if size is not None:
             _m.PREFIX_TIER_ERRORS.labels(tier='disk').inc()
 
-    def put(self, digest: bytes, k: np.ndarray, v: np.ndarray) -> bool:
-        """Persist one block's KV; False when already present (the file
-        contents are digest-determined, so rewriting buys nothing)."""
+    def put(
+        self,
+        digest: bytes,
+        k: np.ndarray,
+        v: np.ndarray,
+        k_scale: np.ndarray | None = None,
+        v_scale: np.ndarray | None = None,
+    ) -> bool:
+        """Persist one block's KV (plus its quantization scales when the
+        pool is int8); False when already present (the file contents are
+        digest-determined, so rewriting buys nothing)."""
         from distllm_tpu.resilience.faults import get_fault_injector
 
         hexdigest = digest.hex()
-        header = json.dumps(
-            {'shape': list(k.shape), 'dtype': str(k.dtype)}
-        ).encode() + b'\n'
+        meta = {'version': 2, 'shape': list(k.shape), 'dtype': str(k.dtype)}
+        meta['scales'] = (
+            None if k_scale is None
+            else {'shape': list(k_scale.shape), 'dtype': str(k_scale.dtype)}
+        )
+        # Compact separators: the header rides every spilled block.
+        header = json.dumps(meta, separators=(',', ':')).encode() + b'\n'
         payload = header + k.tobytes() + v.tobytes()
+        if k_scale is not None:
+            payload += k_scale.tobytes() + v_scale.tobytes()
         with self._lock:
             if hexdigest in self._index:
                 self._index.move_to_end(hexdigest)
@@ -471,16 +494,18 @@ class DiskKVTier:
             self._publish_locked()
         return True
 
-    def get(self, digest: bytes) -> tuple[np.ndarray, np.ndarray] | None:
-        """Load one block's (K, V) host arrays; refreshes its LRU slot.
-        The file read happens OUTSIDE the lock — contains() runs on the
-        admission path and must not stall behind multi-megabyte cold-disk
-        reads. A concurrent eviction racing the read is just a miss.
-        A corrupt or truncated file (bad header, short read — a torn
-        spill from a killed process, bit rot, or a foreign file wearing
-        the suffix) counts a ``distllm_prefix_tier_errors_total{tier=
-        "disk"}``, drops the entry, and returns None: the caller falls
-        through to cold prefill, never an exception in ``add_request``."""
+    def get(self, digest: bytes) -> tuple[np.ndarray, ...] | None:
+        """Load one block's host arrays — ``(K, V)``, or ``(K, V,
+        K_scale, V_scale)`` for a quantized spill — refreshing its LRU
+        slot. The file read happens OUTSIDE the lock — contains() runs on
+        the admission path and must not stall behind multi-megabyte
+        cold-disk reads. A concurrent eviction racing the read is just a
+        miss. A corrupt or truncated file (bad header, short read — a
+        torn spill from a killed process, bit rot, or a foreign file
+        wearing the suffix) and an unknown ``version`` alike count a
+        ``distllm_prefix_tier_errors_total{tier="disk"}``, drop the
+        entry, and return None: the caller falls through to cold
+        prefill, never an exception in ``add_request``."""
         from distllm_tpu.resilience.faults import get_fault_injector
 
         hexdigest = digest.hex()
@@ -500,19 +525,49 @@ class DiskKVTier:
             if not sep:
                 raise ValueError('missing header line')
             meta = json.loads(header)
+            version = int(meta.get('version', 1))
+            if version > 2:
+                # A newer process wrote a layout this reader does not
+                # understand; halving the body blindly would hand the
+                # attention kernel another format's bytes as KV.
+                raise ValueError(f'unknown .kvblock version {version}')
             # jnp.dtype resolves 'bfloat16' through ml_dtypes into a
             # numpy-compatible dtype, so the round trip is byte-exact for
             # bf16 KV.
             dtype = np.dtype(jnp.dtype(meta['dtype']))
             shape = tuple(int(d) for d in meta['shape'])
-            half = len(body) // 2
-            k = np.frombuffer(body[:half], dtype=dtype).reshape(shape)
-            v = np.frombuffer(body[half:], dtype=dtype).reshape(shape)
+            if version < 2:
+                # Version-less pre-int8 spill: body is exactly K then V.
+                half = len(body) // 2
+                k = np.frombuffer(body[:half], dtype=dtype).reshape(shape)
+                v = np.frombuffer(body[half:], dtype=dtype).reshape(shape)
+                return k, v
+            # v2: exact byte offsets from the header (never len//2 — the
+            # optional scale tail would skew the split).
+            scales_meta = meta.get('scales')
+            arrays: list[np.ndarray] = []
+            offset = 0
+            specs = [(shape, dtype), (shape, dtype)]
+            if scales_meta is not None:
+                s_dtype = np.dtype(jnp.dtype(scales_meta['dtype']))
+                s_shape = tuple(int(d) for d in scales_meta['shape'])
+                specs += [(s_shape, s_dtype), (s_shape, s_dtype)]
+            for a_shape, a_dtype in specs:
+                count = int(np.prod(a_shape)) * a_dtype.itemsize
+                chunk = body[offset:offset + count]
+                if len(chunk) != count:
+                    raise ValueError('truncated .kvblock body')
+                arrays.append(
+                    np.frombuffer(chunk, dtype=a_dtype).reshape(a_shape)
+                )
+                offset += count
+            if offset != len(body):
+                raise ValueError('trailing bytes in .kvblock body')
+            return tuple(arrays)
         # distlint: disable=swallowed-exception -- degradation is counted: _drop_entry increments distllm_prefix_tier_errors_total{tier="disk"} and unlinks the corrupt file
         except (ValueError, KeyError, TypeError):
             self._drop_entry(hexdigest, unlink=True)
             return None
-        return k, v
 
     @property
     def num_blocks(self) -> int:
@@ -533,20 +588,23 @@ class HostKVTier:
     fetch per eviction batch) instead of dropping their KV; a later
     same-prefix arrival promotes them back into the paged pool via async
     ``jax.device_put`` (engine ``_begin_promotion``). Entries are whole
-    per-block KV slices (``[L, block_size, N_kv, Hd]`` each for K and V)
-    keyed by the chained block digest, LRU-ordered, bounded by
-    ``max_bytes``. With a :class:`DiskKVTier` attached, spills write
-    THROUGH to disk (persistence never depends on host-LRU timing) and
-    host misses fall through to disk, pulling hits back into the host
-    pool. Thread-safe for the same reason as the disk tier.
+    per-block KV slices (``[L, block_size, N_kv, Hd]`` each for K and V;
+    quantized pools append the two ``[L, N_kv]`` fp32 scale slices) keyed
+    by the chained block digest, LRU-ordered, bounded by ``max_bytes``.
+    With a :class:`DiskKVTier` attached, spills write THROUGH to disk
+    (persistence never depends on host-LRU timing) and host misses fall
+    through to disk, pulling hits back into the host pool. Thread-safe
+    for the same reason as the disk tier.
     """
 
     def __init__(self, max_bytes: int, disk: DiskKVTier | None = None) -> None:
         self._lock = threading.Lock()
         self.max_bytes = int(max_bytes)
         self.disk = disk
-        # digest -> (k, v) host arrays, LRU order (oldest first).
-        self._entries: 'OrderedDict[bytes, tuple[np.ndarray, np.ndarray]]' = (
+        # digest -> (k, v[, k_scale, v_scale]) host arrays, LRU order
+        # (oldest first). Arity follows what was spilled: the tier never
+        # inspects payloads beyond byte accounting.
+        self._entries: 'OrderedDict[bytes, tuple[np.ndarray, ...]]' = (
             OrderedDict()
         )  # guarded by self._lock
         self._bytes = 0  # guarded by self._lock
@@ -560,8 +618,8 @@ class HostKVTier:
         from distllm_tpu.observability import instruments as _m
 
         while self._bytes > self.max_bytes and self._entries:
-            digest, (k, v) = self._entries.popitem(last=False)
-            self._bytes -= k.nbytes + v.nbytes
+            digest, arrays = self._entries.popitem(last=False)
+            self._bytes -= sum(a.nbytes for a in arrays)
             _m.PREFIX_TIER_EVICTIONS.labels(tier='host').inc()
             # Write-through at put() time normally persisted the block,
             # but a full/read-only disk degrades put() to a no-op — so
@@ -587,27 +645,39 @@ class HostKVTier:
         _m.PREFIX_TIER_MISSES.labels(tier='disk' if self.disk else 'host').inc()
         return None
 
-    def put(self, digest: bytes, k: np.ndarray, v: np.ndarray) -> bool:
-        """Adopt one spilled block (host copies of its K/V slices)."""
+    def put(
+        self,
+        digest: bytes,
+        k: np.ndarray,
+        v: np.ndarray,
+        k_scale: np.ndarray | None = None,
+        v_scale: np.ndarray | None = None,
+    ) -> bool:
+        """Adopt one spilled block (host copies of its K/V slices, plus
+        the per-block scale rows for a quantized pool)."""
         from distllm_tpu.observability import instruments as _m
 
+        arrays = (
+            (k, v) if k_scale is None else (k, v, k_scale, v_scale)
+        )
         if self.disk is not None:
-            self.disk.put(digest, k, v)
+            self.disk.put(digest, k, v, k_scale, v_scale)
         with self._lock:
             if digest in self._entries:
                 self._entries.move_to_end(digest)
                 return False
-            self._entries[digest] = (k, v)
-            self._bytes += k.nbytes + v.nbytes
+            self._entries[digest] = arrays
+            self._bytes += sum(a.nbytes for a in arrays)
             _m.PREFIX_TIER_SPILLS.labels(tier='host').inc()
             self._evict_over_budget_locked()
             self._publish_locked()
         return True
 
-    def get(self, digest: bytes) -> tuple[np.ndarray, np.ndarray] | None:
-        """(K, V) for ``digest``, refreshing its LRU slot; host misses
-        fall through to the disk tier, and a disk hit re-enters the host
-        pool (a promoted prefix is about to be hot again)."""
+    def get(self, digest: bytes) -> tuple[np.ndarray, ...] | None:
+        """``(K, V)`` — or ``(K, V, K_scale, V_scale)`` for a quantized
+        spill — for ``digest``, refreshing its LRU slot; host misses fall
+        through to the disk tier, and a disk hit re-enters the host pool
+        (a promoted prefix is about to be hot again)."""
         with self._lock:
             entry = self._entries.get(digest)
             if entry is not None:
@@ -621,14 +691,13 @@ class HostKVTier:
         from distllm_tpu.observability import instruments as _m
 
         _m.PREFIX_TIER_PROMOTIONS.labels(tier='disk').inc()
-        k, v = loaded
         with self._lock:
             if digest not in self._entries:
-                self._entries[digest] = (k, v)
-                self._bytes += k.nbytes + v.nbytes
+                self._entries[digest] = loaded
+                self._bytes += sum(a.nbytes for a in loaded)
                 self._evict_over_budget_locked()
                 self._publish_locked()
-        return k, v
+        return loaded
 
     @property
     def num_blocks(self) -> int:
@@ -665,6 +734,15 @@ class PagedKVCache:
     Block *accounting* — who owns which block, admission, preemption — is
     the scheduler's job (``engine/scheduler.py`` over the native C++ core);
     keeping a second free-list here would silently desync from it.
+
+    With ``dtype='int8'`` each pool array is a
+    :class:`~distllm_tpu.ops.paged_attention.QuantizedKV` — int8 data of
+    the same paged shape plus a per-block-per-KV-head fp32 scale array
+    ``[num_layers, num_blocks, num_kv_heads]`` (docs/serving.md
+    "Quantized KV cache"). QuantizedKV is a NamedTuple pytree, so every
+    jitted engine path that treats the pool as an opaque carry (scan,
+    donation, COW gathers) works unchanged; only code that quantizes,
+    dequantizes, or inspects ``.shape`` dispatches on the container.
     """
 
     def __init__(
@@ -680,6 +758,12 @@ class PagedKVCache:
     ) -> None:
         self.shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
         self.dtype = jnp.dtype(dtype)
+        self.quantized = self.dtype == jnp.dtype(jnp.int8)
+        # Symmetric per-block-per-KV-head scales: one fp32 per (layer,
+        # block, kv head), for K and V independently (the two pool arrays
+        # each carry their own scale plane — the ``[L, blocks, 2, nkv]``
+        # layout realized as its K/V halves).
+        self.scale_shape = (num_layers, num_blocks, num_kv_heads)
         self._sharding = sharding
         self.block_size = block_size
         self.num_blocks = num_blocks
@@ -688,6 +772,26 @@ class PagedKVCache:
         if not lazy:
             self.allocate()
 
+    def _zeros(self):
+        from distllm_tpu.ops.paged_attention import QuantizedKV
+
+        if self._sharding is None:
+            data = jnp.zeros(self.shape, dtype=self.dtype)
+        else:
+            # Allocate directly into the sharded layout: under tensor
+            # parallelism num_blocks is sized against AGGREGATE HBM, so a
+            # transient full-size allocation on one device would OOM.
+            data = jax.jit(
+                lambda: jnp.zeros(self.shape, dtype=self.dtype),
+                out_shardings=self._sharding,
+            )()
+        if not self.quantized:
+            return data
+        # Scales are tiny (4 bytes per block per KV head — ~1/1024 of the
+        # data plane) and are read by every device each dispatch, so they
+        # stay replicated even when the data plane is sharded.
+        return QuantizedKV(data, jnp.zeros(self.scale_shape, jnp.float32))
+
     def allocate(self) -> None:
         """Materialize the pool arrays (``lazy=True`` defers this so the
         engine can run transient-heavy weight migrations first)."""
@@ -695,28 +799,27 @@ class PagedKVCache:
             return
         from distllm_tpu.observability import instruments
 
-        if self._sharding is None:
-            self.k = jnp.zeros(self.shape, dtype=self.dtype)
-            self.v = jnp.zeros(self.shape, dtype=self.dtype)
-        else:
-            # Allocate directly into the sharded layout: under tensor
-            # parallelism num_blocks is sized against AGGREGATE HBM, so a
-            # transient full-size allocation on one device would OOM.
-            zeros = jax.jit(
-                lambda: jnp.zeros(self.shape, dtype=self.dtype),
-                out_shardings=self._sharding,
-            )
-            self.k = zeros()
-            self.v = zeros()
+        self.k = self._zeros()
+        self.v = self._zeros()
         instruments.KV_HBM_BYTES.set(self.hbm_bytes)
 
     def spec(self):
-        """ShapeDtypeStruct for one pool array (AOT compilation input)."""
-        return jax.ShapeDtypeStruct(self.shape, self.dtype)
+        """Shape/dtype pytree for one pool array (AOT compilation input):
+        a bare ShapeDtypeStruct, or a QuantizedKV of them when int8."""
+        data = jax.ShapeDtypeStruct(self.shape, self.dtype)
+        if not self.quantized:
+            return data
+        from distllm_tpu.ops.paged_attention import QuantizedKV
+
+        return QuantizedKV(
+            data, jax.ShapeDtypeStruct(self.scale_shape, jnp.float32)
+        )
 
     def blocks_needed(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
 
     @property
     def hbm_bytes(self) -> int:
-        return int(self.k.nbytes + self.v.nbytes)
+        return int(sum(
+            leaf.nbytes for leaf in jax.tree.leaves((self.k, self.v))
+        ))

@@ -281,6 +281,27 @@ def _layer_window_flags(cfg) -> jnp.ndarray:
     return jnp.arange(cfg.num_layers) % 2 == 0
 
 
+def _kv_layer(cache, li):
+    """Layer ``li``'s slice of a stacked KV cache. ``jax.tree.map`` keeps
+    the emitted HLO identical for bare arrays while slicing every member
+    of an int8 ``QuantizedKV`` (data AND its per-block scales) in one
+    expression — the layer scans stay dtype-agnostic."""
+    return jax.tree.map(
+        lambda c: jax.lax.dynamic_index_in_dim(c, li, 0, keepdims=False),
+        cache,
+    )
+
+
+def _kv_layer_update(cache, cache_l, li):
+    """Write a per-layer KV slice back into the stacked cache (the
+    :func:`_kv_layer` inverse, same bare-array/``QuantizedKV`` duality)."""
+    return jax.tree.map(
+        lambda c, cl: jax.lax.dynamic_update_index_in_dim(c, cl, li, 0),
+        cache,
+        cache_l,
+    )
+
+
 def _attn_mask(attention_mask: jnp.ndarray, cfg: MistralConfig) -> jnp.ndarray:
     """Causal x key-validity boolean mask ``[B, 1, S, S]`` (+ sliding window)."""
     seq = attention_mask.shape[1]
@@ -384,8 +405,8 @@ def prefill_paged(  # distlint: traced
     def layer(carry, xs):
         x, k_cache, v_cache = carry
         lp, li, window_l = xs
-        k_cache_l = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
-        v_cache_l = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
+        k_cache_l = _kv_layer(k_cache, li)
+        v_cache_l = _kv_layer(v_cache, li)
         normed = _norm(x, lp['attn_ln']['scale'], cfg)
         q = common.split_heads(
             common.dense(
@@ -440,8 +461,8 @@ def prefill_paged(  # distlint: traced
         mlp = _mlp_block(normed2, lp, cfg)
         if getattr(cfg, 'post_norms', False):
             mlp = _norm(mlp, lp['post_mlp_ln']['scale'], cfg)
-        k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k_cache_l, li, 0)
-        v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v_cache_l, li, 0)
+        k_cache = _kv_layer_update(k_cache, k_cache_l, li)
+        v_cache = _kv_layer_update(v_cache, v_cache_l, li)
         return (x + mlp, k_cache, v_cache), None
 
     (x, k_cache, v_cache), _ = jax.lax.scan(
@@ -662,8 +683,8 @@ def _decode_core(
     def layer(carry, xs):
         x, k_cache, v_cache = carry
         lp, li, window_l = xs
-        k_cache_l = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
-        v_cache_l = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
+        k_cache_l = _kv_layer(k_cache, li)
+        v_cache_l = _kv_layer(v_cache, li)
         normed = _norm(x, lp['attn_ln']['scale'], cfg)
         q = common.dense(
             normed, lp['q']['kernel'], lp['q'].get('bias'), qmm_backend=qb
@@ -693,8 +714,8 @@ def _decode_core(
         mlp = _mlp_block(normed2, lp, cfg)
         if getattr(cfg, 'post_norms', False):
             mlp = _norm(mlp, lp['post_mlp_ln']['scale'], cfg)
-        k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k_cache_l, li, 0)
-        v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v_cache_l, li, 0)
+        k_cache = _kv_layer_update(k_cache, k_cache_l, li)
+        v_cache = _kv_layer_update(v_cache, v_cache_l, li)
         return (x + mlp, k_cache, v_cache), None
 
     (x, k_cache, v_cache), _ = jax.lax.scan(

@@ -73,6 +73,31 @@ ATTN_BACKEND_LABELS = ('xla', 'pallas', 'interpret')
 for _backend in ATTN_BACKEND_LABELS:
     ATTN_BACKEND_INFO.labels(backend=_backend)
 
+KV_CACHE_DTYPE_INFO = _registry.gauge(
+    'distllm_engine_kv_cache_dtype_info',
+    'RESOLVED storage dtype of the paged KV pool '
+    "(EngineConfig.kv_cache_dtype after 'auto' resolution, pinned at "
+    'construction; docs/serving.md "Quantized KV cache"). Exactly one '
+    'dtype label reads 1.',
+    labelnames=('dtype',),
+)
+# Canonical jnp dtype names for the resolvable pool dtypes, plus a
+# catch-all for model dtypes outside the usual set ('auto' follows the
+# model). Same single-owner discipline as ATTN_BACKEND_LABELS.
+KV_CACHE_DTYPE_LABELS = ('bfloat16', 'float32', 'int8', 'other')
+for _dtype in KV_CACHE_DTYPE_LABELS:
+    KV_CACHE_DTYPE_INFO.labels(dtype=_dtype)
+
+ENGINE_KV_DISPATCH_BYTES = _registry.gauge(
+    'distllm_engine_kv_dispatch_bytes',
+    'XLA-measured bytes accessed per serving dispatch, by dispatch kind '
+    '(cost_analysis on the compiled executable — the roofline numerator; '
+    'docs/observability.md "Measured vs analytic MFU"). The int8 KV '
+    'pool shows here as the decode/mixed kinds dropping by roughly the '
+    'KV stream share.',
+    labelnames=('kind',),
+)
+
 # ------------------------------------------------------------- KV cache
 KV_BLOCKS_TOTAL = _registry.gauge(
     'distllm_kv_cache_blocks_total',

@@ -38,6 +38,7 @@ do NOT automatically reach it).
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -84,6 +85,88 @@ def kv_sublane_tile(kv_dtype) -> int:
     a ``block_size``-row band of its folded VMEM buffer, so ``block_size``
     must be a multiple of this."""
     return max(1, 32 // jnp.dtype(kv_dtype).itemsize)
+
+
+class QuantizedKV(NamedTuple):
+    """Int8 paged-KV container: block data plus per-block-per-KV-head scales.
+
+    ``data`` keeps the paged layout (``[..., num_blocks, block_size,
+    num_kv_heads, head_dim]`` int8) and ``scale`` a parallel fp32 array
+    with the block-size and head-dim axes dropped (``[..., num_blocks,
+    num_kv_heads]``) — symmetric quantization, ``x ≈ data * scale`` with
+    ``scale = absmax / 127`` over the block's live rows per KV head. The
+    engine's pool carries a leading layer axis on both members; per-layer
+    slices inside the model scans drop it.
+
+    A NamedTuple is an automatic pytree, so a ``QuantizedKV`` rides the
+    existing k/v argument slots through ``jax.jit`` (donation applies to
+    both leaves), ``lax.scan`` carries, and ``jax.tree.map``-written
+    block ops (gather/scatter/copy treat data and scale uniformly
+    because the block axis is axis -4 of ``data`` and axis -2 of
+    ``scale`` — axis 1 of each for the engine's pool). Full-precision
+    caches stay bare arrays: every op in this module dispatches on
+    ``isinstance(cache, QuantizedKV)`` so the unquantized paths emit
+    bit-identical HLO to the pre-int8 code.
+    """
+
+    data: jnp.ndarray
+    scale: jnp.ndarray
+
+
+# Symmetric int8 range: scale = absmax / KV_QUANT_MAX maps the block's
+# largest magnitude to +/-127 (-128 unused, keeping the code symmetric).
+KV_QUANT_MAX = 127.0
+
+
+def kv_storage_dtype(cache):
+    """The dtype KV blocks are stored as (int8 for :class:`QuantizedKV`)."""
+    if isinstance(cache, QuantizedKV):
+        return jnp.dtype(cache.data.dtype)
+    return jnp.dtype(cache.dtype)
+
+
+def _kv_data(cache):
+    return cache.data if isinstance(cache, QuantizedKV) else cache
+
+
+def quantize_kv_rows(rows, scale):  # distlint: traced
+    """Quantize ``rows`` (``[..., num_kv_heads, head_dim]``) against a
+    per-KV-head ``scale`` (``[..., num_kv_heads]``). Zero scales (fresh
+    all-zero blocks, trash-block garbage) emit exact zeros — the guarded
+    denominator keeps the traced division finite so no NaN can reach the
+    scatter even on the dead branch of the ``where``."""
+    denom = jnp.where(scale > 0, scale, 1.0)[..., None]
+    q = jnp.round(rows.astype(jnp.float32) / denom)
+    q = jnp.clip(q, -KV_QUANT_MAX, KV_QUANT_MAX)
+    return jnp.where(scale[..., None] > 0, q, 0.0).astype(jnp.int8)
+
+
+def _rescale_int8_blocks(data, old_scale, new_scale):  # distlint: traced
+    """Re-express int8 block rows quantized at ``old_scale`` in units of
+    ``new_scale`` (``data [..., block_size, num_kv_heads, head_dim]``,
+    scales ``[..., num_kv_heads]``). Appends only ever GROW a block's
+    running absmax (``new_scale >= old_scale``), so the ratio is <= 1 and
+    the rounded product stays in range; zero ``new_scale`` (fresh or
+    trash blocks) zeroes the stale rows."""
+    denom = jnp.where(new_scale > 0, new_scale, 1.0)
+    ratio = jnp.where(new_scale > 0, old_scale / denom, 0.0)
+    out = jnp.round(data.astype(jnp.float32) * ratio[..., None, :, None])
+    return jnp.clip(out, -KV_QUANT_MAX, KV_QUANT_MAX).astype(jnp.int8)
+
+
+def _gather_kv_blocks(cache, block_tables):  # distlint: traced
+    """Gather ``[B, max_blocks, block_size, num_kv_heads, head_dim]``
+    blocks for attention, dequantizing int8 caches in the same fused
+    expression (XLA folds the scale multiply into the gather consumers —
+    no separate dequant pass or fp32 cache copy is ever materialized).
+    Bare-array caches take the exact pre-int8 gather."""
+    if isinstance(cache, QuantizedKV):
+        scales = cache.scale[block_tables]  # [B, max_blocks, num_kv_heads]
+        return (
+            cache.data[block_tables].astype(jnp.float32)
+            * scales[:, :, None, :, None]
+        )
+    return cache[block_tables]
 
 
 def resolve_attn_backend(
@@ -137,13 +220,18 @@ def paged_attention_xla(  # distlint: traced
     the scaled scores before masking (both gemma2).
     """
     b, num_heads, head_dim = q.shape
-    _, block_size, num_kv_heads, _ = k_cache.shape
+    _, block_size, num_kv_heads, _ = _kv_data(k_cache).shape
     max_blocks = block_tables.shape[1]
     group = num_heads // num_kv_heads
 
     # [B, max_blocks, block_size, Nkv, Hd] -> [B, T, Nkv, Hd]
-    k = k_cache[block_tables].reshape(b, max_blocks * block_size, num_kv_heads, head_dim)
-    v = v_cache[block_tables].reshape(b, max_blocks * block_size, num_kv_heads, head_dim)
+    # (int8 caches dequantize inside the gather expression)
+    k = _gather_kv_blocks(k_cache, block_tables).reshape(
+        b, max_blocks * block_size, num_kv_heads, head_dim
+    )
+    v = _gather_kv_blocks(v_cache, block_tables).reshape(
+        b, max_blocks * block_size, num_kv_heads, head_dim
+    )
 
     qg = q.reshape(b, num_kv_heads, group, head_dim).astype(jnp.float32)
     scores = jnp.einsum('bkgd,btkd->bkgt', qg, k.astype(jnp.float32))
@@ -213,14 +301,14 @@ def ragged_paged_attention_xla(  # distlint: traced
     against.
     """
     b, s, num_heads, head_dim = q.shape
-    _, block_size, num_kv_heads, _ = k_cache.shape
+    _, block_size, num_kv_heads, _ = _kv_data(k_cache).shape
     max_blocks = block_tables.shape[1]
     group = num_heads // num_kv_heads
 
-    k = k_cache[block_tables].reshape(
+    k = _gather_kv_blocks(k_cache, block_tables).reshape(
         b, max_blocks * block_size, num_kv_heads, head_dim
     )
-    v = v_cache[block_tables].reshape(
+    v = _gather_kv_blocks(v_cache, block_tables).reshape(
         b, max_blocks * block_size, num_kv_heads, head_dim
     )
     qg = q.reshape(b, s, num_kv_heads, group, head_dim).astype(jnp.float32)
@@ -282,12 +370,14 @@ def paged_prefill_attention_xla(  # distlint: traced
 
 
 def _ragged_paged_attn_kernel(
-    # scalar-prefetch operands (SMEM)
-    block_tables_ref,  # [B, max_blocks] int32
-    context_lens_ref,  # [B] int32
-    q_start_ref,  # [B] int32 — absolute position of each row's first query
-    q_lens_ref,  # [B] int32 — valid queries per row (0 = fully padded row)
-    window_ref,  # [1] int32 — sliding window; <= 0 disables
+    # Operand order (positional, by grid-spec contract):
+    #
+    # scalar-prefetch (SMEM):
+    #   block_tables_ref,  # [B, max_blocks] int32
+    #   context_lens_ref,  # [B] int32
+    #   q_start_ref,  # [B] int32 — absolute position of row's first query
+    #   q_lens_ref,  # [B] int32 — valid queries per row (0 = fully padded)
+    #   window_ref,  # [1] int32 — sliding window; <= 0 disables
     # array operands. The KV caches arrive HEAD-FOLDED: the caller
     # bitcast-reshapes [num_blocks, block_size, num_kv_heads, head_dim]
     # to [num_blocks, block_size, num_kv_heads * head_dim] (row-major —
@@ -299,21 +389,25 @@ def _ragged_paged_attn_kernel(
     # per-head HBM DMA slices (cache[page, :, h]) break sublane tile
     # alignment whenever num_kv_heads < the tile — while a static lane
     # slice at a 128 multiple is always tile-aligned.
-    q_ref,  # [num_kv_heads, span_tile * group, head_dim] (VMEM) — one tile
-    k_cache_ref,  # [num_blocks, block_size, num_kv_heads * head_dim] (HBM)
-    v_cache_ref,
-    out_ref,  # [num_kv_heads, span_tile * group, head_dim] (VMEM)
-    # scratch — buffers are pre-flattened [slot, chunk_tokens, folded]:
+    #   q_ref,  # [num_kv_heads, span_tile * group, head_dim] (VMEM)
+    #   k_cache_ref,  # [num_blocks, block_size, num_kv_heads*head_dim] (HBM)
+    #   v_cache_ref,
+    #   [k_scale_ref, v_scale_ref]  # quantized only: [num_blocks, 128]
+    #       fp32 (HBM) — per-block per-KV-head scales, lane-padded to 128
+    #       so each page's scale row DMAs with an aligned minor dim
+    #   out_ref,  # [num_kv_heads, span_tile * group, head_dim] (VMEM)
+    # scratch — KV buffers are pre-flattened [slot, chunk_tokens, folded]:
     # each page DMAs into a statically-offset row band, so the compute
     # side never reshapes at all (a traced-slot reshape was the third
     # Mosaic lowering rejection this layout designs out).
-    k_buf,  # [2, chunk_tokens, num_kv_heads * head_dim] VMEM
-    v_buf,
-    sems,  # DMA semaphores [2, pages_per_chunk, 2]
-    acc_ref,  # [num_kv_heads, span_tile * group, head_dim] fp32
-    m_ref,  # [num_kv_heads, span_tile * group, 128] fp32, lane-replicated
-    l_ref,  # [num_kv_heads, span_tile * group, 128] fp32, lane-replicated
-    *,
+    #   k_buf,  # [2, chunk_tokens, num_kv_heads * head_dim] VMEM
+    #   v_buf,
+    #   [ks_buf, vs_buf]  # quantized only: [2, pages_per_chunk, 128] fp32
+    #   sems,  # DMA semaphores [2, pages_per_chunk, 2 (4 when quantized)]
+    #   acc_ref,  # [num_kv_heads, span_tile * group, head_dim] fp32
+    #   m_ref,  # [num_kv_heads, span_tile*group, 128] fp32, lane-replicated
+    #   l_ref,  # [num_kv_heads, span_tile*group, 128] fp32, lane-replicated
+    *refs,
     block_size: int,
     pages_per_chunk: int,
     num_kv_heads: int,
@@ -321,6 +415,7 @@ def _ragged_paged_attn_kernel(
     span_tile: int,
     scale: float,
     logit_softcap: float | None,
+    quantized: bool = False,
 ):
     """Grid (B, q_tiles, kv_chunks): one row × one query tile × one chunk
     of KV pages per step.
@@ -344,6 +439,20 @@ def _ragged_paged_attn_kernel(
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if quantized:
+        (
+            block_tables_ref, context_lens_ref, q_start_ref, q_lens_ref,
+            window_ref, q_ref, k_cache_ref, v_cache_ref, k_scale_ref,
+            v_scale_ref, out_ref, k_buf, v_buf, ks_buf, vs_buf, sems,
+            acc_ref, m_ref, l_ref,
+        ) = refs
+    else:
+        (
+            block_tables_ref, context_lens_ref, q_start_ref, q_lens_ref,
+            window_ref, q_ref, k_cache_ref, v_cache_ref, out_ref,
+            k_buf, v_buf, sems, acc_ref, m_ref, l_ref,
+        ) = refs
 
     seq = pl.program_id(0)
     qt = pl.program_id(1)
@@ -393,6 +502,20 @@ def _ragged_paged_attn_kernel(
                 v_buf.at[slot, rows_at],
                 sems.at[slot, p, 1],
             ).start()
+            if quantized:
+                # The page's scale row rides the same double-buffered
+                # prefetch: a 128-lane fp32 row per page (512 B) next to
+                # the page's int8 payload — dequant needs no extra pass.
+                pltpu.make_async_copy(
+                    k_scale_ref.at[page_id],
+                    ks_buf.at[slot, p],
+                    sems.at[slot, p, 2],
+                ).start()
+                pltpu.make_async_copy(
+                    v_scale_ref.at[page_id],
+                    vs_buf.at[slot, p],
+                    sems.at[slot, p, 3],
+                ).start()
 
     def wait(slot):
         for p in range(pages_per_chunk):
@@ -407,6 +530,17 @@ def _ragged_paged_attn_kernel(
                 v_buf.at[slot, rows_at],
                 sems.at[slot, p, 1],
             ).wait()
+            if quantized:
+                pltpu.make_async_copy(
+                    k_scale_ref.at[0],
+                    ks_buf.at[slot, p],
+                    sems.at[slot, p, 2],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_scale_ref.at[0],
+                    vs_buf.at[slot, p],
+                    sems.at[slot, p, 3],
+                ).wait()
 
     @pl.when(c == 0)
     def _():
@@ -448,11 +582,35 @@ def _ragged_paged_attn_kernel(
         # per-layer window where 0 means global).
         valid = valid & ((kvp > qp - win) | (win <= 0))
 
+        if quantized:
+            # Per-key page index [1, C]: dequant applies each page's
+            # per-head scale to its block_size-column band of the scores
+            # (q · (k_int8 · s) == (q · k_int8) · s per key column), so
+            # the int8 band feeds the MXU untouched and the scale is one
+            # VPU multiply on the [rows, C] scores — the fused-dequant
+            # shape, never an fp32 KV copy in VMEM.
+            col_page = jax.lax.broadcasted_iota(
+                jnp.int32, (1, chunk_tokens), 1
+            ) // block_size
+
+            def page_scale_vec(scale_buf, slot, h):
+                vec = jnp.zeros((1, chunk_tokens), jnp.float32)
+                for p in range(pages_per_chunk):  # static unroll
+                    vec = jnp.where(
+                        col_page == p, scale_buf[slot, p, h], vec
+                    )
+                return vec
+
         for h in range(num_kv_heads):  # static unroll over KV heads
             qh = q_ref[h]  # [rows, Hd]
             # Head h is a static LANE band of the folded buffer — a
             # 128-aligned slice, always tile-aligned.
             kh = k_buf[slot, :, h * head_dim:(h + 1) * head_dim]  # [C, Hd]
+            if kh.dtype != qh.dtype:
+                # int8 bands (and bf16 pools under fp32 models) promote
+                # to the query dtype for the MXU dot; int8 magnitudes
+                # (<= 127) are exact in bf16's 8-bit significand.
+                kh = kh.astype(qh.dtype)
             scores = (
                 jax.lax.dot_general(
                     qh, kh,
@@ -461,6 +619,8 @@ def _ragged_paged_attn_kernel(
                 )
                 * scale
             )  # [rows, C]
+            if quantized:
+                scores = scores * page_scale_vec(ks_buf, slot, h)
             if logit_softcap is not None:
                 cap = jnp.float32(logit_softcap)
                 scores = jnp.tanh(scores / cap) * cap
@@ -479,6 +639,13 @@ def _ragged_paged_attn_kernel(
                 probs, axis=-1, keepdims=True
             )
             vh = v_buf[slot, :, h * head_dim:(h + 1) * head_dim]  # [C, Hd]
+            if quantized:
+                # probs · (v_int8 · s) == (probs · s_per_key) · v_int8:
+                # fold V's per-page scale into the probabilities (one
+                # [rows, C] VPU multiply) and promote the int8 band to
+                # the query dtype for the MXU — same fusion as K.
+                probs = probs * page_scale_vec(vs_buf, slot, h)
+                vh = vh.astype(q_ref.dtype)
             pv = jax.lax.dot_general(
                 probs.astype(vh.dtype), vh,
                 dimension_numbers=(((1,), (0,)), ((), ())),
@@ -559,8 +726,10 @@ def ragged_paged_attention_pallas(
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    quantized = isinstance(k_cache, QuantizedKV)
+    k_data, v_data = _kv_data(k_cache), _kv_data(v_cache)
     b, s, num_heads, head_dim = q.shape
-    num_blocks, block_size, num_kv_heads, _ = k_cache.shape
+    num_blocks, block_size, num_kv_heads, _ = k_data.shape
     max_blocks = block_tables.shape[1]
     group = num_heads // num_kv_heads
     if head_dim % 128 and not interpret:
@@ -572,17 +741,18 @@ def ragged_paged_attention_pallas(
         )
     # Each page DMAs into a [block_size]-row band of the folded KV buffer,
     # so the band offsets must land on sublane-tile boundaries (16 rows
-    # for 2-byte dtypes, 8 for fp32). EngineConfig's default block_size of
-    # 16 satisfies every serving dtype, and 'auto' resolution
-    # (resolve_attn_backend with the block geometry) routes misaligned
-    # configs to XLA before ever tracing here — reaching this raise means
-    # an explicit 'pallas' pin.
-    sublane = kv_sublane_tile(k_cache.dtype)
+    # for 2-byte dtypes, 8 for fp32, 32 for int8). EngineConfig's default
+    # block_size of 16 satisfies every full-precision serving dtype but
+    # NOT int8 KV, and 'auto' resolution (resolve_attn_backend with the
+    # block geometry) routes misaligned configs to XLA before ever
+    # tracing here — reaching this raise means an explicit 'pallas' pin.
+    sublane = kv_sublane_tile(k_data.dtype)
     if block_size % sublane and not interpret:
         raise ValueError(
             f'pallas paged attention needs block_size % {sublane} == 0 '
-            f'for {jnp.dtype(k_cache.dtype).name} KV caches, '
-            f'got {block_size}'
+            f'for {jnp.dtype(k_data.dtype).name} KV caches, '
+            f'got {block_size}; use block_size={sublane} '
+            "(EngineConfig.block_size) or attn_backend='xla'"
         )
     if pages_per_chunk is None:
         pages_per_chunk = max(1, 128 // block_size)
@@ -623,12 +793,30 @@ def ragged_paged_attention_pallas(
     # 128-aligned lane band — the layout that keeps whole-page DMA
     # descriptors contiguous AND per-head slices tile-aligned (see the
     # kernel docstring for the two Mosaic rejections this designs out).
-    k_folded = k_cache.reshape(
+    k_folded = k_data.reshape(
         num_blocks, block_size, num_kv_heads * head_dim
     )
-    v_folded = v_cache.reshape(
+    v_folded = v_data.reshape(
         num_blocks, block_size, num_kv_heads * head_dim
     )
+    extra_operands = []
+    if quantized:
+        if num_kv_heads > 128:
+            raise ValueError(
+                'pallas int8 paged attention supports at most 128 KV '
+                f'heads (one scale lane row per page), got {num_kv_heads}'
+            )
+        # Scale rows pad to a full 128-lane minor dim so each page's
+        # per-head scales DMA as one aligned [128] fp32 row (512 B)
+        # beside the page's int8 payload. The pad is a tiny HLO pad of
+        # the [nb, nkv] scale array per dispatch, not a cache copy.
+        extra_operands = [
+            jnp.pad(
+                c.scale.astype(jnp.float32),
+                ((0, 0), (0, 128 - num_kv_heads)),
+            )
+            for c in (k_cache, v_cache)
+        ]
 
     rows = span_tile * group
     kernel = functools.partial(
@@ -642,7 +830,25 @@ def ragged_paged_attention_pallas(
         logit_softcap=(
             None if logit_softcap is None else float(logit_softcap)
         ),
+        quantized=quantized,
     )
+    kv_scratch = [
+        pltpu.VMEM(
+            (2, pages_per_chunk * block_size,
+             num_kv_heads * head_dim),
+            k_data.dtype,
+        ),
+        pltpu.VMEM(
+            (2, pages_per_chunk * block_size,
+             num_kv_heads * head_dim),
+            v_data.dtype,
+        ),
+    ]
+    if quantized:
+        kv_scratch += [
+            pltpu.VMEM((2, pages_per_chunk, 128), jnp.float32),
+            pltpu.VMEM((2, pages_per_chunk, 128), jnp.float32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(b, num_q_tiles, num_chunks),
@@ -653,23 +859,15 @@ def ragged_paged_attention_pallas(
             ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        ] + [pl.BlockSpec(memory_space=pl.ANY)] * len(extra_operands),
         out_specs=pl.BlockSpec(
             (None, num_kv_heads, rows, head_dim),
             lambda i, qi, j, *_: (i, 0, qi, 0),
         ),
-        scratch_shapes=[
-            pltpu.VMEM(
-                (2, pages_per_chunk * block_size,
-                 num_kv_heads * head_dim),
-                k_cache.dtype,
+        scratch_shapes=kv_scratch + [
+            pltpu.SemaphoreType.DMA(
+                (2, pages_per_chunk, 4 if quantized else 2)
             ),
-            pltpu.VMEM(
-                (2, pages_per_chunk * block_size,
-                 num_kv_heads * head_dim),
-                v_cache.dtype,
-            ),
-            pltpu.SemaphoreType.DMA((2, pages_per_chunk, 2)),
             pltpu.VMEM((num_kv_heads, rows, head_dim), jnp.float32),
             pltpu.VMEM((num_kv_heads, rows, 128), jnp.float32),
             pltpu.VMEM((num_kv_heads, rows, 128), jnp.float32),
@@ -691,6 +889,7 @@ def ragged_paged_attention_pallas(
         qg,
         k_folded,
         v_folded,
+        *extra_operands,
     )
     return (
         out.reshape(b, num_kv_heads, s, group, head_dim)
@@ -784,6 +983,43 @@ def paged_attention_pallas(
     )[:, 0]
 
 
+def _write_token_kv_quantized(k_cache, v_cache, new_k, new_v, block_ids,
+                              offsets):  # distlint: traced
+    """Quantize-at-write for the decode path: rescale-on-append.
+
+    Each touched block keeps a RUNNING absmax (its scale only grows):
+    the appended row's per-head absmax joins the block's current scale,
+    the block's existing int8 rows are ratio-multiplied into the new
+    units (one gathered [B, bs, nkv, hd] rescale — never a re-walk of
+    the original activations), and the fresh row is quantized once at
+    the final scale. A row landing at block offset 0 starts a fresh
+    block, so its inherited scale resets to 0. Frozen/dead rows arrive
+    routed to the trash block 0 (duplicate scatter indices land there
+    nondeterministically — garbage, but finite: scales are amax/127 and
+    the guarded quant/rescale divisions can never mint a NaN for the
+    masked softmax to multiply).
+    """
+
+    def write_one(cache, new):
+        amax = jnp.max(
+            jnp.abs(new.astype(jnp.float32)), axis=-1
+        )  # [B, nkv]
+        scale_before = jnp.where(
+            (offsets == 0)[:, None], 0.0, cache.scale[block_ids]
+        )
+        new_scale = jnp.maximum(scale_before, amax / KV_QUANT_MAX)
+        blocks = _rescale_int8_blocks(
+            cache.data[block_ids], scale_before, new_scale
+        )
+        data = cache.data.at[block_ids].set(blocks)
+        data = data.at[block_ids, offsets].set(
+            quantize_kv_rows(new, new_scale)
+        )
+        return QuantizedKV(data, cache.scale.at[block_ids].set(new_scale))
+
+    return write_one(k_cache, new_k), write_one(v_cache, new_v)
+
+
 def write_token_kv(  # distlint: traced
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
@@ -792,11 +1028,16 @@ def write_token_kv(  # distlint: traced
     block_tables: jnp.ndarray,  # [B, max_blocks]
     positions: jnp.ndarray,  # [B] token index being written
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Scatter one new token's K/V per sequence into its paged block."""
-    block_size = k_cache.shape[1]
+    """Scatter one new token's K/V per sequence into its paged block
+    (quantizing at write time for int8 :class:`QuantizedKV` pools)."""
+    block_size = _kv_data(k_cache).shape[1]
     batch = positions.shape[0]
     block_ids = block_tables[jnp.arange(batch), positions // block_size]
     offsets = positions % block_size
+    if isinstance(k_cache, QuantizedKV):
+        return _write_token_kv_quantized(
+            k_cache, v_cache, new_k, new_v, block_ids, offsets
+        )
     k_cache = k_cache.at[block_ids, offsets].set(new_k.astype(k_cache.dtype))
     v_cache = v_cache.at[block_ids, offsets].set(new_v.astype(v_cache.dtype))
     return k_cache, v_cache
@@ -819,7 +1060,7 @@ def write_chunk_kv(  # distlint: traced
     per-row raggedness — invalid positions write to the reserved trash
     block 0, the same pad-safety contract as :func:`write_prefill_kv`.
     """
-    block_size = k_cache.shape[1]
+    block_size = _kv_data(k_cache).shape[1]
     b, s = positions.shape
     block_ids = jnp.where(
         valid,
@@ -827,6 +1068,11 @@ def write_chunk_kv(  # distlint: traced
         0,
     )
     offsets = jnp.where(valid, positions % block_size, 0)
+    if isinstance(k_cache, QuantizedKV):
+        return _write_chunk_kv_quantized(
+            k_cache, v_cache, new_k, new_v, block_tables, positions,
+            valid, block_ids, offsets,
+        )
     flat_blocks = block_ids.reshape(-1)
     flat_offsets = offsets.reshape(-1)
     k_flat = new_k.reshape(b * s, *new_k.shape[2:])
@@ -838,6 +1084,76 @@ def write_chunk_kv(  # distlint: traced
         v_flat.astype(v_cache.dtype)
     )
     return k_cache, v_cache
+
+
+def _write_chunk_kv_quantized(k_cache, v_cache, new_k, new_v, block_tables,
+                              positions, valid, block_ids,
+                              offsets):  # distlint: traced
+    """Ragged-span quantize-at-write (the :func:`write_chunk_kv` int8
+    path). A row's span covers a CONTIGUOUS run of at most
+    ``S // block_size + 1`` blocks (spans are position-consecutive with
+    trailing-pad ``valid`` masks — the same contract the Pallas kernel
+    scalar-prefetches one start position per row on), so the touched set
+    is a static-width gather: per touched block take the running-absmax
+    max of the block's prior scale (0 when the span covers the block's
+    offset 0 — a fresh block) and the span tokens landing in it, rescale
+    the gathered int8 rows once, scatter them back, then scatter the new
+    tokens quantized at the final per-block scales. Dead rows / dead
+    touched slots route to the trash block 0 exactly like the
+    full-precision path (finite garbage, see
+    :func:`_write_token_kv_quantized`)."""
+    block_size = k_cache.data.shape[1]
+    b, s = positions.shape
+    max_blocks = block_tables.shape[1]
+    nt = s // block_size + 1  # static max blocks a span can touch
+    start_blk = positions[:, 0] // block_size  # [B] first logical block
+    touched = start_blk[:, None] + jnp.arange(nt)[None, :]  # [B, nt]
+    touched_cl = jnp.clip(touched, 0, max_blocks - 1)
+    last_pos = jnp.max(jnp.where(valid, positions, -1), axis=1)  # [B]
+    live = (touched <= last_pos[:, None] // block_size) & (
+        last_pos[:, None] >= 0
+    )
+    phys = jnp.where(
+        live, jnp.take_along_axis(block_tables, touched_cl, axis=1), 0
+    )  # [B, nt] physical touched blocks (dead -> trash)
+    fresh = touched * block_size >= positions[:, :1]  # span covers row 0
+    tb = jnp.clip(
+        positions // block_size - start_blk[:, None], 0, nt - 1
+    )  # [B, S] touched-slot index per token
+    onehot = (
+        tb[:, :, None] == jnp.arange(nt)[None, None, :]
+    ) & valid[:, :, None]  # [B, S, nt]
+
+    def write_one(cache, new):
+        amax_tok = jnp.max(
+            jnp.abs(new.astype(jnp.float32)), axis=-1
+        )  # [B, S, nkv]
+        contrib = jnp.max(
+            jnp.where(onehot[..., None], amax_tok[:, :, None, :], 0.0),
+            axis=1,
+        )  # [B, nt, nkv] span absmax per touched block
+        scale_before = jnp.where(fresh[..., None], 0.0, cache.scale[phys])
+        new_scale = jnp.maximum(scale_before, contrib / KV_QUANT_MAX)
+        blocks = _rescale_int8_blocks(
+            cache.data[phys], scale_before, new_scale
+        )
+        flat_phys = phys.reshape(-1)
+        data = cache.data.at[flat_phys].set(
+            blocks.reshape(-1, *blocks.shape[2:])
+        )
+        scale = cache.scale.at[flat_phys].set(
+            new_scale.reshape(-1, new_scale.shape[-1])
+        )
+        scale_tok = jnp.take_along_axis(
+            new_scale, tb[:, :, None], axis=1
+        )  # [B, S, nkv]
+        q = quantize_kv_rows(new, scale_tok)
+        data = data.at[block_ids.reshape(-1), offsets.reshape(-1)].set(
+            q.reshape(b * s, *q.shape[2:])
+        )
+        return QuantizedKV(data, scale)
+
+    return write_one(k_cache, new_k), write_one(v_cache, new_v)
 
 
 def write_prefill_kv(  # distlint: traced
@@ -856,11 +1172,51 @@ def write_prefill_kv(  # distlint: traced
     real data through XLA's nondeterministic duplicate-index scatter.
     """
     seq_len = k_seq.shape[0]
-    block_size = k_cache.shape[1]
+    block_size = _kv_data(k_cache).shape[1]
     positions = jnp.arange(seq_len)
     valid = positions < length
     block_ids = jnp.where(valid, block_table_row[positions // block_size], 0)
     offsets = jnp.where(valid, positions % block_size, 0)
+    if isinstance(k_cache, QuantizedKV):
+        return _write_prefill_kv_quantized(
+            k_cache, v_cache, k_seq, v_seq, block_table_row, length,
+            block_ids, offsets, valid,
+        )
     k_cache = k_cache.at[block_ids, offsets].set(k_seq.astype(k_cache.dtype))
     v_cache = v_cache.at[block_ids, offsets].set(v_seq.astype(v_cache.dtype))
     return k_cache, v_cache
+
+
+def _write_prefill_kv_quantized(k_cache, v_cache, k_seq, v_seq,
+                                block_table_row, length, block_ids,
+                                offsets, valid):  # distlint: traced
+    """Whole-sequence quantize-at-write (the :func:`write_prefill_kv`
+    int8 path). A full prefill writes every block from its offset 0, so
+    every touched block is FRESH: each block's scale is simply the
+    absmax of its live rows (token → block is the static ``s //
+    block_size`` map — no running-absmax bookkeeping needed), and each
+    row quantizes once at its block's final scale. Pad rows and dead
+    blocks route to the trash block 0 (finite garbage, same contract as
+    the full-precision path)."""
+    seq_len = k_seq.shape[0]
+    block_size = k_cache.data.shape[1]
+    nt = -(-seq_len // block_size)
+    pad = nt * block_size - seq_len
+    live_blk = jnp.arange(nt) * block_size < length
+    phys = jnp.where(live_blk, block_table_row[jnp.arange(nt)], 0)
+
+    def write_one(cache, seq):
+        amax = jnp.max(jnp.abs(seq.astype(jnp.float32)), axis=-1)
+        amax = jnp.where(valid[:, None], amax, 0.0)  # [S, nkv]
+        contrib = jnp.pad(amax, ((0, pad), (0, 0))).reshape(
+            nt, block_size, -1
+        ).max(axis=1)  # [nt, nkv]
+        new_scale = contrib / KV_QUANT_MAX
+        scale = cache.scale.at[phys].set(new_scale)
+        scale_tok = jnp.repeat(new_scale, block_size, axis=0)[:seq_len]
+        data = cache.data.at[block_ids, offsets].set(
+            quantize_kv_rows(seq, scale_tok)
+        )
+        return QuantizedKV(data, scale)
+
+    return write_one(k_cache, k_seq), write_one(v_cache, v_seq)

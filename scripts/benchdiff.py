@@ -68,9 +68,19 @@ _HIGHER_BETTER_SUFFIXES = ('value', 'mfu', 'vs_baseline')
 # design: shed volume is offered-load policy, not quality — a round that
 # sheds more under a heavier schedule is not a regression ('shed_rate'
 # deliberately matches no gated token).
+# 'greedy_match' gates the gen_kvq stage's ACCURACY arm (docs/serving.md
+# "Quantized KV cache"): the fraction of the int8-KV arm's greedy tokens
+# matching the bf16-KV arm's on the same workload. Falling match fraction
+# is a QUALITY regression — the compression got lossier — and trips the
+# trajectory gate exactly like a throughput fall; the stage records the
+# divergence rather than asserting it away, and this token is what keeps
+# that honesty enforceable round over round. Direction rule: higher is
+# better (1.0 = bit-identical streams), so the generic higher-better
+# machinery applies; a tolerance is the gate --threshold, not a
+# stage-side epsilon.
 _HIGHER_BETTER_TOKENS = (
     'goodput', 'accept_rate', 'hit_rate', 'tok_s', 'mfu_measured',
-    'bw_util_measured', 'promotion_overlap', 'recoveries',
+    'bw_util_measured', 'promotion_overlap', 'recoveries', 'greedy_match',
 )
 
 

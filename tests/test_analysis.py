@@ -670,6 +670,42 @@ class TestTracedPythonBranch:
         )
         assert diags == []
 
+    def test_isinstance_dispatch_is_static_and_clean(self):
+        # The QuantizedKV-vs-bare-array pytree dispatch idiom
+        # (ops/paged_attention.py write paths): isinstance inspects the
+        # container's Python type at trace time — never a traced value —
+        # even when the SAME name is later rebound from a device
+        # expression (the flow-insensitive fixpoint must not leak that
+        # back into the isinstance test).
+        diags = run_rules(
+            'import jax\nimport jax.numpy as jnp\n'
+            '@jax.jit\n'
+            'def f(cache, new):\n'
+            '    if isinstance(cache, tuple):\n'
+            '        return cache\n'
+            '    cache = cache + jnp.sum(new)\n'
+            '    return cache\n',
+            ['traced-python-branch'],
+        )
+        assert diags == []
+
+    def test_isinstance_bound_flag_is_static_and_clean(self):
+        # `quantized = isinstance(...)` is a static bool, not a
+        # device-derived value — branching on it later stays clean
+        # (engine._write_prefill_all_layers).
+        diags = run_rules(
+            'import jax\nimport jax.numpy as jnp\n'
+            '@jax.jit\n'
+            'def f(cache, new):\n'
+            '    cache = cache + jnp.sum(new)\n'
+            '    quantized = isinstance(cache, tuple)\n'
+            '    if quantized:\n'
+            '        return cache\n'
+            '    return -cache\n',
+            ['traced-python-branch'],
+        )
+        assert diags == []
+
     def test_closure_reaches_scan_body(self):
         diags = run_rules(
             'import jax\nimport jax.numpy as jnp\n'

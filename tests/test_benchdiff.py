@@ -167,6 +167,66 @@ def test_gen_chaos_gate_directions():
     assert benchdiff.gate_direction('gen_chaos_faults_injected') is None
 
 
+def test_gen_kvq_gate_directions():
+    """ISSUE 17: the quantized-KV stage's accuracy fraction gates
+    higher-better — a FALLING greedy match is a quality regression (the
+    compression got lossier) and must trip the gate like a throughput
+    fall. Byte/capacity evidence stays informational: pool bytes and
+    capacity are geometry facts, not round-over-round quality."""
+    assert benchdiff.gate_direction('gen_kvq_greedy_match') == 'higher'
+    assert benchdiff.gate_direction('gen_kvq_int8_tok_s') == 'higher'
+    assert benchdiff.gate_direction('gen_kvq_bf16_tok_s') == 'higher'
+    assert (
+        benchdiff.gate_direction('gen_kvq_int8_bw_util_measured') == 'higher'
+    )
+    assert benchdiff.gate_direction('gen_kvq_speedup') == 'higher'
+    assert benchdiff.gate_direction('gen_kvq_int8_kv_pool_bytes') is None
+    assert benchdiff.gate_direction('gen_kvq_kv_pool_bytes_ratio') is None
+    assert benchdiff.gate_direction('gen_kvq_int8_capacity_blocks') is None
+    assert (
+        benchdiff.gate_direction('gen_kvq_int8_decode_bytes_accessed') is None
+    )
+
+
+def test_gen_kvq_accuracy_regression_trips_gate(tmp_path):
+    """A fallen greedy-match fraction alone (tok/s flat) trips the gate:
+    the accuracy arm is enforceable, not decorative."""
+    prior = {
+        'n': 7, 'rc': 0,
+        'parsed': {
+            'gen_kvq_int8_tok_s': 180.0,
+            'gen_kvq_greedy_match': 0.95,
+            'gen_kvq_kv_pool_bytes_ratio': 0.502,
+        },
+    }
+    ok_current = {
+        'n': 8, 'rc': 0,
+        'parsed': {
+            'gen_kvq_int8_tok_s': 182.0,
+            'gen_kvq_greedy_match': 0.94,  # within --threshold
+            'gen_kvq_kv_pool_bytes_ratio': 0.51,
+        },
+    }
+    bad_current = {
+        'n': 8, 'rc': 0,
+        'parsed': {
+            'gen_kvq_int8_tok_s': 181.0,    # throughput fine
+            'gen_kvq_greedy_match': 0.40,   # compression got lossier
+            'gen_kvq_kv_pool_bytes_ratio': 0.51,
+        },
+    }
+    (tmp_path / 'prior.json').write_text(json.dumps(prior))
+    (tmp_path / 'ok.json').write_text(json.dumps(ok_current))
+    (tmp_path / 'bad.json').write_text(json.dumps(bad_current))
+
+    proc = _run(tmp_path / 'prior.json', tmp_path / 'ok.json')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    proc = _run(tmp_path / 'prior.json', tmp_path / 'bad.json')
+    assert proc.returncode == 1
+    assert 'gen_kvq_greedy_match' in proc.stdout
+
+
 def test_gen_chaos_regression_trips_gate(tmp_path):
     """A CPU-smoke-shaped gen_chaos fragment: dropped recoveries and
     goodput trip the gate; a shed-rate swing alone does not."""

@@ -1,0 +1,366 @@
+"""Quantized int8 KV cache tests (docs/serving.md "Quantized KV cache"):
+per-block absmax error bounds, quantize-at-write parity across the three
+write paths, fused-dequant attention parity (XLA and the interpreted
+Pallas kernel), backend resolution for the int8 sublane tile, and
+engine-level identity / accuracy contracts for ``kv_cache_dtype``.
+
+Error-bound discipline: a SINGLE-SHOT write (prefill, block-aligned
+chunks) quantizes every row once at its block's final scale, so the
+round-trip error is at most half a quantization step — ``scale / 2 ==
+absmax / 254``. The APPEND path (decode's rescale-on-append) re-expresses
+earlier int8 rows whenever the running absmax grows, adding a second
+rounding — its bound is ~1 step of the FINAL scale, not absmax/254. The
+tests below encode the distinction; collapsing them to one bound would
+either mask append-path regressions or flake on legitimate rescales.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distllm_tpu.generate.engine import EngineConfig, LLMEngine, SamplingParams
+from distllm_tpu.generate.engine.kv_cache import PagedKVCache
+from distllm_tpu.models import mistral
+from distllm_tpu.ops.paged_attention import (
+    KV_QUANT_MAX,
+    QuantizedKV,
+    kv_storage_dtype,
+    kv_sublane_tile,
+    paged_attention_pallas,
+    paged_attention_xla,
+    quantize_kv_rows,
+    resolve_attn_backend,
+    write_chunk_kv,
+    write_prefill_kv,
+    write_token_kv,
+)
+
+
+def _dequant(cache: QuantizedKV) -> np.ndarray:
+    data = np.asarray(cache.data, np.float32)
+    scale = np.asarray(cache.scale, np.float32)
+    return data * scale[:, None, :, None]
+
+
+def _zero_quant_cache(num_blocks=4, block_size=4, nkv=2, hd=8):
+    data = jnp.zeros((num_blocks, block_size, nkv, hd), jnp.int8)
+    scale = jnp.zeros((num_blocks, nkv), jnp.float32)
+    return QuantizedKV(data, scale)
+
+
+# ------------------------------------------------------------ unit: quantize
+def test_quantize_kv_rows_error_bound(rng):
+    rows = jnp.asarray(rng.normal(size=(6, 3, 16)).astype(np.float32)) * 5.0
+    absmax = jnp.max(jnp.abs(rows), axis=-1)  # [6, 3]
+    scale = absmax / KV_QUANT_MAX
+    q = quantize_kv_rows(rows, scale)
+    assert q.dtype == jnp.int8
+    err = np.abs(
+        np.asarray(q, np.float32) * np.asarray(scale)[..., None]
+        - np.asarray(rows)
+    )
+    # Single-shot bound: half a step of the row's own scale.
+    bound = np.asarray(scale)[..., None] / 2 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_quantize_kv_rows_zero_scale_is_exact_zero(rng):
+    # Fresh all-zero blocks and trash-block garbage carry scale 0: the
+    # guarded division must emit exact zeros, never NaN/inf (a NaN here
+    # would poison every masked softmax that multiplies the trash block).
+    rows = jnp.asarray(rng.normal(size=(2, 2, 4)).astype(np.float32))
+    q = quantize_kv_rows(rows, jnp.zeros((2, 2), jnp.float32))
+    assert np.asarray(q).sum() == 0
+    assert np.isfinite(np.asarray(q, np.float32)).all()
+
+
+# ------------------------------------------------------- unit: write paths
+def test_write_prefill_kv_quantized_scales_and_error(rng):
+    k_cache = _zero_quant_cache()
+    v_cache = _zero_quant_cache()
+    k_seq = jnp.asarray(rng.normal(size=(8, 2, 8)).astype(np.float32))
+    v_seq = jnp.asarray(rng.normal(size=(8, 2, 8)).astype(np.float32))
+    row = jnp.asarray([1, 2, 0, 0], dtype=jnp.int32)
+    k_cache, v_cache = write_prefill_kv(
+        k_cache, v_cache, k_seq, v_seq, row, jnp.int32(6)
+    )
+    assert isinstance(k_cache, QuantizedKV)
+    assert kv_storage_dtype(k_cache) == jnp.dtype(jnp.int8)
+    # Block 1 holds tokens 0..3, block 2 tokens 4..5: each block's scale
+    # is exactly the absmax of its LIVE rows / 127, K and V independent.
+    k_np = np.asarray(k_seq)
+    expect_b1 = np.abs(k_np[:4]).max(axis=(0, 2)) / KV_QUANT_MAX
+    expect_b2 = np.abs(k_np[4:6]).max(axis=(0, 2)) / KV_QUANT_MAX
+    np.testing.assert_allclose(
+        np.asarray(k_cache.scale[1]), expect_b1, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(k_cache.scale[2]), expect_b2, rtol=1e-6
+    )
+    deq = _dequant(k_cache)
+    scale = np.asarray(k_cache.scale)
+    # Single-shot bound over the live rows.
+    err1 = np.abs(deq[1] - k_np[:4])
+    assert (err1 <= scale[1][None, :, None] / 2 + 1e-6).all()
+    err2 = np.abs(deq[2][:2] - k_np[4:6])
+    assert (err2 <= scale[2][None, :, None] / 2 + 1e-6).all()
+    # Rows past `length` stayed zero (the trash block ate the padding).
+    assert np.asarray(k_cache.data[2][2:]).sum() == 0
+
+
+def test_write_token_kv_rescale_on_append_error_bound(rng):
+    # Fill one block token by token with GROWING magnitudes, forcing a
+    # rescale of the already-written int8 rows on every append — the
+    # worst case for the running-absmax path.
+    block_size, nkv, hd = 4, 2, 8
+    k_cache = _zero_quant_cache(block_size=block_size, nkv=nkv, hd=hd)
+    v_cache = _zero_quant_cache(block_size=block_size, nkv=nkv, hd=hd)
+    table = jnp.asarray([[1, 0, 0, 0]], dtype=jnp.int32)
+    rows = [
+        rng.normal(size=(1, nkv, hd)).astype(np.float32) * (1.0 + 3.0 * t)
+        for t in range(block_size)
+    ]
+    for t, r in enumerate(rows):
+        k_cache, v_cache = write_token_kv(
+            k_cache, v_cache, jnp.asarray(r), jnp.asarray(r * 2.0),
+            table, jnp.asarray([t], dtype=jnp.int32),
+        )
+    written = np.concatenate(rows, axis=0)  # [block_size, nkv, hd]
+    final_scale = np.asarray(k_cache.scale[1])  # [nkv]
+    # The running absmax only grows, so the final scale covers the
+    # largest row exactly.
+    np.testing.assert_allclose(
+        final_scale, np.abs(written).max(axis=(0, 2)) / KV_QUANT_MAX,
+        rtol=1e-6,
+    )
+    err = np.abs(_dequant(k_cache)[1] - written)
+    # APPEND bound: ~1 step of the FINAL scale (quantize once + at most
+    # a ratio re-round per row), looser than the single-shot scale/2.
+    assert (err <= 1.5 * final_scale[None, :, None] + 1e-6).all()
+
+
+def test_write_chunk_kv_quantized_block_aligned_matches_prefill(rng):
+    # Block-aligned chunks write each block fresh in one shot, so the
+    # chunk path must land the SAME scales (and the same single-shot
+    # error bound) as one whole-sequence prefill of the identical rows.
+    block_size, nkv, hd = 4, 2, 8
+    seq = rng.normal(size=(8, nkv, hd)).astype(np.float32)
+    row = jnp.asarray([1, 2, 0, 0], dtype=jnp.int32)
+
+    pk, pv = write_prefill_kv(
+        _zero_quant_cache(), _zero_quant_cache(),
+        jnp.asarray(seq), jnp.asarray(seq), row, jnp.int32(8),
+    )
+
+    ck, cv = _zero_quant_cache(), _zero_quant_cache()
+    table = row[None, :]
+    for start in (0, 4):
+        positions = jnp.arange(start, start + block_size)[None, :]
+        ck, cv = write_chunk_kv(
+            ck, cv,
+            jnp.asarray(seq[start:start + block_size])[None],
+            jnp.asarray(seq[start:start + block_size])[None],
+            table, positions, jnp.ones((1, block_size), bool),
+        )
+    np.testing.assert_allclose(
+        np.asarray(ck.scale), np.asarray(pk.scale), rtol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(ck.data), np.asarray(pk.data))
+
+
+# -------------------------------------------------- fused-dequant attention
+def _random_quant_cache(rng, num_blocks=8, block_size=4, nkv=2, hd=8):
+    data = rng.integers(-127, 128, size=(num_blocks, block_size, nkv, hd))
+    scale = rng.uniform(0.01, 0.1, size=(num_blocks, nkv))
+    return QuantizedKV(
+        jnp.asarray(data.astype(np.int8)),
+        jnp.asarray(scale.astype(np.float32)),
+    )
+
+
+def test_paged_attention_xla_int8_matches_dequantized_cache(rng):
+    # The fused gather-dequant must be numerically the SAME attention as
+    # running the bare-array path over a materialized fp32 dequant.
+    k_cache = _random_quant_cache(rng)
+    v_cache = _random_quant_cache(rng)
+    block_tables = jnp.asarray([[2, 5], [7, 0]], dtype=jnp.int32)
+    context_lens = jnp.asarray([6, 3], dtype=jnp.int32)
+    q = jnp.asarray(rng.normal(size=(2, 4, 8)).astype(np.float32))
+    fused = np.asarray(
+        paged_attention_xla(q, k_cache, v_cache, block_tables, context_lens)
+    )
+    dense = np.asarray(
+        paged_attention_xla(
+            q, jnp.asarray(_dequant(k_cache)), jnp.asarray(_dequant(v_cache)),
+            block_tables, context_lens,
+        )
+    )
+    np.testing.assert_allclose(fused, dense, atol=1e-5, rtol=1e-4)
+
+
+def test_paged_attention_pallas_interpret_matches_xla_int8(rng):
+    # The kernel's per-page scale DMA + fused scores/probs scaling
+    # against the XLA gather-dequant reference, on the interpreter.
+    k_cache = _random_quant_cache(rng)
+    v_cache = _random_quant_cache(rng)
+    block_tables = jnp.asarray([[2, 5], [7, 0]], dtype=jnp.int32)
+    context_lens = jnp.asarray([6, 3], dtype=jnp.int32)
+    q = jnp.asarray(rng.normal(size=(2, 4, 8)).astype(np.float32))
+    ref = np.asarray(
+        paged_attention_xla(q, k_cache, v_cache, block_tables, context_lens)
+    )
+    out = np.asarray(
+        paged_attention_pallas(
+            q, k_cache, v_cache, block_tables, context_lens, interpret=True
+        )
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+
+
+# ------------------------------------------------------- backend resolution
+def test_kv_sublane_tile_by_dtype():
+    assert kv_sublane_tile('int8') == 32
+    assert kv_sublane_tile('bfloat16') == 16
+    assert kv_sublane_tile('float32') == 8
+
+
+def test_resolve_auto_int8_misaligned_block_size_keeps_xla():
+    # 'auto' must NEVER trace into the kernel's geometry ValueError: the
+    # default block_size=16 with an int8 pool (sublane tile 32) resolves
+    # to the XLA tier on every platform. Alignment alone doesn't force
+    # 'pallas' (that needs a TPU), but misalignment must force 'xla'.
+    cfg = mistral.MistralConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64, dtype='float32',
+    )
+    assert resolve_attn_backend(
+        'auto', cfg, block_size=16, kv_dtype='int8'
+    ) == 'xla'
+    # Explicit pins pass through untouched — the ENGINE owns the loud
+    # construction-time raise for those (test below).
+    assert resolve_attn_backend(
+        'pallas', cfg, block_size=16, kv_dtype='int8'
+    ) == 'pallas'
+
+
+# ------------------------------------------------------------------ engine
+def _engine(kv_cache_dtype='auto', dtype='float32', **cfg_kw):
+    cfg = mistral.MistralConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64, dtype=dtype,
+    )
+    params = mistral.init(jax.random.PRNGKey(0), cfg)
+
+    class IdTokenizer:
+        eos_id = None
+
+        def decode(self, ids):
+            return ' '.join(str(i) for i in ids)
+
+    engine_cfg = EngineConfig(
+        block_size=cfg_kw.pop('block_size', 4),
+        num_blocks=cfg_kw.pop('num_blocks', 64),
+        max_num_seqs=4,
+        max_model_len=64,
+        prefer_native_allocator=False,
+        kv_cache_dtype=kv_cache_dtype,
+        **cfg_kw,
+    )
+    return LLMEngine(cfg, params, IdTokenizer(), engine_cfg)
+
+
+def test_engine_explicit_pallas_pin_int8_misaligned_raises():
+    # The actionable construction-time raise (NOT a mid-warmup Mosaic
+    # trace error): explicit kernel pin + int8 + block_size 4.
+    with pytest.raises(ValueError, match='use block_size=32'):
+        _engine(kv_cache_dtype='int8', attn_backend='interpret')
+
+
+def test_engine_fp32_pin_matches_auto_bit_exact():
+    # Explicit 'fp32' on an fp32 model is the SAME pool dtype 'auto'
+    # picks: token streams must be bit-identical (the default-config
+    # compatibility contract — kv_cache_dtype='auto' changes nothing).
+    prompts = [[5, 9, 12], [7, 3, 22, 31, 40, 2, 17]]
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+    auto = _engine('auto').generate_ids(prompts, sp)
+    pinned = _engine('fp32').generate_ids(prompts, sp)
+    assert pinned == auto
+
+
+@pytest.mark.parametrize(
+    'extra',
+    [
+        dict(enable_prefix_cache=True),
+        dict(enable_prefix_cache=True, prefill_chunk_tokens=8),
+        dict(
+            enable_prefix_cache=True,
+            prefill_chunk_tokens=8,
+            enable_mixed_batching=True,
+            max_window_prefill_tokens=8,
+        ),
+        dict(draft_k=2),
+    ],
+    ids=['prefix', 'chunked', 'mixed', 'spec'],
+)
+def test_engine_bf16_pin_identity_matrix(extra):
+    # Satellite: explicit kv_cache_dtype='bf16' on a bf16 model IS
+    # today's default pool — token identity must hold across the
+    # existing identity matrix (prefix cache x chunked x mixed x spec),
+    # not just the plain batched path.
+    shared = list(range(1, 11))
+    prompts = [shared + [20], shared + [30, 31, 32], [7, 3, 22, 31, 40]]
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+    auto = _engine('auto', dtype='bfloat16', **extra)
+    pinned = _engine('bf16', dtype='bfloat16', **extra)
+    assert auto.generate_ids(prompts, sp) == pinned.generate_ids(prompts, sp)
+    assert pinned.telemetry['kv_cache_dtype'] == 'bfloat16'
+
+
+def test_engine_int8_end_to_end_greedy_divergence_recorded():
+    # int8 serves end to end; divergence from the float engine is
+    # MEASURED and bounded below, not asserted to zero — per-block absmax
+    # keeps a tiny random model's greedy stream mostly aligned, and a
+    # collapse of the match fraction means the quantizer broke.
+    prompts = [[5, 9, 12], [7, 3, 22, 31, 40, 2, 17], [1, 2, 3, 4, 5]]
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    ref_engine = _engine('auto')
+    ref = ref_engine.generate_ids(prompts, sp)
+    q_engine = _engine('int8')
+    assert q_engine.telemetry['kv_cache_dtype'] == 'int8'
+    assert q_engine.kv.quantized
+    out = q_engine.generate_ids(prompts, sp)
+    assert [len(o) for o in out] == [len(r) for r in ref]
+    total = sum(len(r) for r in ref)
+    matched = sum(
+        sum(1 for a, b in zip(o, r) if a == b) for o, r in zip(out, ref)
+    )
+    match = matched / total
+    # Evidence floor, not an identity claim: sustained agreement shows
+    # the dequantized cache is feeding real attention, while the exact
+    # fraction stays a recorded metric (bench gen_kvq_greedy_match).
+    assert match >= 0.5, f'greedy match collapsed: {match:.3f}'
+
+
+def test_engine_int8_pool_bytes_halve():
+    fp = _engine('fp32')
+    q = _engine('int8')
+    ratio = q.kv.hbm_bytes / fp.kv.hbm_bytes
+    # int8 data is 1/4 of fp32 + the fp32 scale planes; against a bf16
+    # pool the same layout lands at ~0.5. Either way it must be well
+    # under the full-precision pool.
+    assert ratio < 0.5
+    assert isinstance(q.kv.k, QuantizedKV)
+    assert q.kv.k.scale.shape == (2, 64, 2)
+
+
+def test_paged_kv_cache_int8_spec_is_quantized_pytree():
+    pool = PagedKVCache(
+        num_layers=2, num_blocks=8, block_size=4, num_kv_heads=2,
+        head_dim=8, dtype='int8',
+    )
+    spec = pool.spec()
+    assert isinstance(spec, QuantizedKV)
+    assert spec.data.dtype == jnp.dtype(jnp.int8)
+    assert spec.scale.shape == (2, 8, 2)

@@ -88,7 +88,8 @@ def test_disk_tier_round_trip_and_budget(tmp_path):
     assert rb.dtype == kb.dtype
     assert rb.tobytes() == kb.tobytes()
     # Byte budget: a tiny-budget tier keeps only the newest entries.
-    small = DiskKVTier(tmp_path / 'small', max_bytes=300)
+    # (Budget sized for ONE 256-byte block plus its v2 header.)
+    small = DiskKVTier(tmp_path / 'small', max_bytes=340)
     small.put(_digest(2), *_block(2))
     small.put(_digest(3), *_block(3))
     assert not small.contains(_digest(2))
@@ -391,6 +392,147 @@ def test_corrupt_disk_tier_falls_through_to_cold_prefill(tmp_path):
     assert got == first == _dense_greedy(cfg, params, PROMPT_A, 4)
     assert _m.PREFIX_TIER_ERRORS.labels(tier='disk').value > errors_before
     assert not engine._stats.get('tier_promotions')
+
+
+# --------------------------------- quantized int8 KV tier (docs/serving.md)
+def test_disk_tier_v2_scales_round_trip(tmp_path):
+    """A quantized spill (int8 data + fp32 per-block scales) round-trips
+    byte-exactly through the v2 .kvblock layout — the body is sliced at
+    exact header-derived offsets, never halved."""
+    tier = DiskKVTier(tmp_path, max_bytes=1 << 20)
+    rng = np.random.default_rng(0)
+    k = rng.integers(-127, 128, size=(2, 4, 2, 8)).astype(np.int8)
+    v = rng.integers(-127, 128, size=(2, 4, 2, 8)).astype(np.int8)
+    ks = rng.uniform(0.01, 0.1, size=(2, 2)).astype(np.float32)
+    vs = rng.uniform(0.01, 0.1, size=(2, 2)).astype(np.float32)
+    assert tier.put(_digest(0), k, v, ks, vs)
+    got = tier.get(_digest(0))
+    assert len(got) == 4
+    for a, b in zip(got, (k, v, ks, vs)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+    # Restart: a fresh instance parses the same v2 files.
+    fresh = DiskKVTier(tmp_path, max_bytes=1 << 20)
+    assert len(fresh.get(_digest(0))) == 4
+
+
+def test_disk_tier_versionless_kvblock_still_loads(tmp_path):
+    """Pre-int8 spills (no ``version`` field, body = K bytes then V
+    bytes) must keep loading on the legacy halve-the-body path — a repo
+    upgrade must not cold-start every existing spill directory."""
+    import json as _json
+
+    tier = DiskKVTier(tmp_path, max_bytes=1 << 20)
+    k = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    v = k * 2
+    # Index the digest via a normal put, then rewrite the file in the
+    # legacy layout behind the tier's back.
+    assert tier.put(_digest(0), k, v)
+    header = _json.dumps(
+        {'shape': list(k.shape), 'dtype': str(k.dtype)}
+    ).encode() + b'\n'
+    path = tmp_path / f'{_digest(0).hex()}.kvblock'
+    path.write_bytes(header + k.tobytes() + v.tobytes())
+    rk, rv = tier.get(_digest(0))
+    np.testing.assert_array_equal(rk, k)
+    np.testing.assert_array_equal(rv, v)
+
+
+def test_disk_tier_unknown_version_degrades_to_miss(tmp_path):
+    """A .kvblock from a NEWER format (version 3) counts a
+    distllm_prefix_tier_errors_total{tier="disk"}, drops the entry, and
+    returns None — an old reader must cold-prefill, never hand the
+    attention kernel another layout's bytes."""
+    import json as _json
+
+    from distllm_tpu.observability import instruments as _m
+
+    tier = DiskKVTier(tmp_path, max_bytes=1 << 20)
+    k = np.arange(8, dtype=np.float32)
+    assert tier.put(_digest(0), k, k)
+    header = _json.dumps(
+        {'version': 3, 'shape': [8], 'dtype': 'float32'}
+    ).encode() + b'\n'
+    path = tmp_path / f'{_digest(0).hex()}.kvblock'
+    path.write_bytes(header + k.tobytes() + k.tobytes())
+    errors_before = _m.PREFIX_TIER_ERRORS.labels(tier='disk').value
+    assert tier.get(_digest(0)) is None
+    assert (
+        _m.PREFIX_TIER_ERRORS.labels(tier='disk').value == errors_before + 1
+    )
+    assert tier.num_blocks == 0
+    assert not path.exists()
+
+
+def test_int8_spill_promote_round_trip_bit_exact():
+    """The int8 pool's spill→promote loop is LOSSLESS: int8 data and
+    fp32 scales ride the tiers as-is (no requantization), so a tier-on
+    int8 engine must emit byte-identical tokens to a tier-off int8
+    engine on the same eviction-churn workload."""
+    _, _, on = _tiny_engine(
+        host_kv_tier_bytes=64 << 20, kv_cache_dtype='int8', **TIER_POOL
+    )
+    _, _, off = _tiny_engine(kv_cache_dtype='int8', **TIER_POOL)
+    assert on.kv.quantized and off.kv.quantized
+    for prompt in (PROMPT_A, PROMPT_B, PROMPT_A):
+        assert (
+            on.generate_ids([prompt], GREEDY)[0]
+            == off.generate_ids([prompt], GREEDY)[0]
+        )
+    assert on.tier_summary()['spilled_blocks'] > 0
+    assert on._stats['tier_promotions'] >= 1
+
+
+def test_int8_disk_warm_restart_promotes(tmp_path):
+    """A fresh int8 engine over the previous process's spill directory
+    promotes int8 blocks + scales from disk and reproduces the first
+    engine's tokens — the v2 format carries everything promotion needs."""
+    _, _, first = _tiny_engine(
+        host_kv_tier_bytes=64 << 20,
+        disk_kv_tier_dir=str(tmp_path),
+        kv_cache_dtype='int8',
+        **TIER_POOL,
+    )
+    want = first.generate_ids([PROMPT_A], GREEDY)[0]
+    first.generate_ids([PROMPT_B], GREEDY)  # evict A's blocks -> disk
+    assert first.kv_tier.disk.num_blocks > 0
+    first.shutdown()
+
+    _, _, fresh = _tiny_engine(
+        host_kv_tier_bytes=64 << 20,
+        disk_kv_tier_dir=str(tmp_path),
+        kv_cache_dtype='int8',
+        **TIER_POOL,
+    )
+    assert fresh.generate_ids([PROMPT_A], GREEDY)[0] == want
+    assert fresh._stats['tier_promotions'] >= 1
+
+
+def test_fp32_engine_over_int8_spills_cold_prefills(tmp_path):
+    """Payload-arity defense: a full-precision engine meeting a
+    quantized pool's 4-array spills must treat every one as a miss
+    (tier_payload_mismatches), cold-prefill, and still emit dense-exact
+    tokens — never scatter int8 bytes into an fp32 pool."""
+    _, _, q = _tiny_engine(
+        host_kv_tier_bytes=1,  # write-through then immediate host evict
+        disk_kv_tier_dir=str(tmp_path),
+        kv_cache_dtype='int8',
+        **TIER_POOL,
+    )
+    q.generate_ids([PROMPT_A], GREEDY)
+    q.generate_ids([PROMPT_B], GREEDY)
+    assert q.kv_tier.disk.num_blocks > 0
+    q.shutdown()
+
+    cfg, params, fp = _tiny_engine(
+        host_kv_tier_bytes=64 << 20,
+        disk_kv_tier_dir=str(tmp_path),
+        **TIER_POOL,
+    )
+    got = fp.generate_ids([PROMPT_A], GREEDY)[0]
+    assert got == _dense_greedy(cfg, params, PROMPT_A, 4)
+    assert fp._stats.get('tier_payload_mismatches', 0) >= 1
+    assert not fp._stats.get('tier_promoted_blocks')
 
 
 def test_disk_tier_warm_restart_bit_exact(tmp_path):
