@@ -1739,6 +1739,283 @@ def _stage_gen_chaos() -> dict:
     return out
 
 
+def _stage_gen_history() -> dict:
+    """Telemetry-history serving stage (docs/observability.md "Metric
+    history & sampling"): the open-loop loadgen with the metric-history
+    ring live, gating that the retention layer, the SLO burn-rate
+    engine, and the runtime regression sentinel actually work against
+    real traffic — not just unit fixtures.
+
+    Five arms on one engine:
+
+    - **clean** (sampler on): fault-free serving; its measured tok/s and
+      TTFT/TPOT p95 distill into a baseline envelope through the SHARED
+      ``build_envelope`` (the ``benchdiff.py --emit-baseline`` code
+      path, so this stage and the offline gate can never disagree on
+      what a record says);
+    - **identity** (sampler OFF): the same workload with no sampler
+      thread running — history is pure host-side observation, so tokens
+      must be BIT-IDENTICAL to the clean arm (greedy fp32; asserted,
+      not assumed);
+    - **verify** (sentinel armed with the clean envelope): the same
+      workload again — a sentinel judging a run statistically identical
+      to its own baseline must stay QUIET (0 regressions);
+    - **slow** (``slow_window`` fault armed): every decode window eats an
+      injected sleep, throughput collapses — the sentinel must fire
+      ≥ 1 regression, and a second pass must fire 0 (the episode latch);
+    - **overload** (admission control + a hopeless TTFT SLO, denser
+      schedule): misses flow into ``distllm_request_slo_total`` and the
+      60 s burn-rate gauge must move off zero.
+
+    Thread hygiene rides along: after the stage stops its sampler, no
+    live thread may carry ``SAMPLER_THREAD_NAME``.
+    ``DISTLLM_BENCH_HISTORY=0`` skips the stage.
+    """
+    import threading
+
+    import jax
+
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.generate.loadgen import (
+        LoadgenConfig,
+        build_workload,
+        run_loadgen,
+    )
+    from distllm_tpu.models import mistral
+    from distllm_tpu.observability.baseline import build_envelope
+    from distllm_tpu.observability.history import (
+        SAMPLER_THREAD_NAME,
+        HistorySampler,
+        get_metrics_history,
+    )
+    from distllm_tpu.observability.sentinel import RegressionSentinel
+    from distllm_tpu.observability.slo import slo_status, update_burn_gauges
+    from distllm_tpu.resilience import get_fault_injector
+
+    prefix = 'gen_history_'
+    if os.environ.get('DISTLLM_BENCH_HISTORY', '1') in ('', '0'):
+        return {f'{prefix}skipped': 'DISTLLM_BENCH_HISTORY=0'}
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    if small:
+        # fp32 so the history-on/off identity check is bit-exact; tiny
+        # dims keep the single warmup in the fast tier.
+        model_cfg = mistral.MistralConfig(
+            vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+            num_kv_heads=4, intermediate_size=512, dtype='float32',
+        )
+        max_num_seqs, num_blocks, max_model_len, decode_steps = 4, 160, 128, 4
+        load_cfg = LoadgenConfig(
+            seed=0, num_requests=24, rate_rps=16.0, num_sessions=3,
+            warm_fraction=0.5, prefix_tokens=32, prompt_tokens=(8, 32),
+            output_tokens=(4, 12), vocab_size=model_cfg.vocab_size,
+        )
+        overload_cfg = LoadgenConfig(
+            seed=1, num_requests=32, rate_rps=200.0, num_sessions=3,
+            warm_fraction=0.5, prefix_tokens=32, prompt_tokens=(8, 32),
+            output_tokens=(4, 12), vocab_size=model_cfg.vocab_size,
+        )
+        slo_s, overload_slo_s = 2.0, 0.02
+        sample_interval_s, slow_delay_s = 0.25, 0.2
+    else:
+        model_cfg = mistral.MistralConfig(dtype='bfloat16')  # 7B defaults
+        max_num_seqs, num_blocks, max_model_len, decode_steps = (
+            32, 712, 512, 16
+        )
+        load_cfg = LoadgenConfig(
+            seed=0, num_requests=192, rate_rps=16.0, num_sessions=16,
+            warm_fraction=0.6, prefix_tokens=64, prompt_tokens=(32, 192),
+            output_tokens=(16, 96), vocab_size=model_cfg.vocab_size,
+        )
+        overload_cfg = LoadgenConfig(
+            seed=1, num_requests=128, rate_rps=256.0, num_sessions=16,
+            warm_fraction=0.6, prefix_tokens=64, prompt_tokens=(32, 192),
+            output_tokens=(16, 64), vocab_size=model_cfg.vocab_size,
+        )
+        slo_s, overload_slo_s = 4.0, 0.25
+        sample_interval_s, slow_delay_s = 1.0, 0.5
+    engine_cfg = EngineConfig(
+        block_size=16,
+        num_blocks=num_blocks,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        decode_steps=decode_steps,
+        pipeline_depth=2,
+        sampling_top_window=64,
+        enable_prefix_cache=True,
+        ttft_slo_s=slo_s,
+        attribution=True,
+    )
+    cache_before = _cache_entries()
+    warmup_start = time.perf_counter()
+    engine, fallback_reason = _build_engine_with_fallback(
+        model_cfg,
+        engine_cfg,
+        lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+        [[1, 2, 3]],
+        SamplingParams(temperature=0.0, max_tokens=2),
+    )
+    warmup_secs = time.perf_counter() - warmup_start
+
+    history = get_metrics_history()
+    history.clear()  # this stage's windows, not a prior stage's tail
+    sampler = HistorySampler(history, interval_s=sample_interval_s)
+    workload = build_workload(load_cfg)
+
+    # Clean arm (sampler on) → the live-measured baseline envelope.
+    sampler.start()
+    clean = run_loadgen(engine, workload)
+    history.sample_once()  # fold the tail before the envelope reads
+    envelope = build_envelope(
+        {
+            f'{prefix}tok_s': clean.achieved_tok_s,
+            f'{prefix}ttft_p95': clean.percentiles.get('ttft_p95'),
+            f'{prefix}tpot_p95': clean.percentiles.get('tpot_p95'),
+        },
+        source='gen_history clean arm',
+    )
+
+    # Identity arm: sampler stopped — history off must not change tokens.
+    sampler.stop()
+    identity = run_loadgen(engine, workload)
+    identical = identity.tokens_by_request == clean.tokens_by_request
+    sampler.start()
+
+    # Verify arm: the sentinel armed with the clean arm's own envelope
+    # must stay quiet on a statistically identical run. Thresholds are
+    # loose (50%) because live windows include idle sampler ticks the
+    # end-of-run aggregate never sees.
+    verify = run_loadgen(engine, workload)
+    history.sample_once()
+    sentinel_quiet = RegressionSentinel(
+        history, envelope=envelope, threshold=0.5,
+        window_s=verify.elapsed_s + 2.0 * sample_interval_s,
+    )
+    clean_fired = sentinel_quiet.evaluate()
+
+    # Slow arm: a per-window injected sleep collapses throughput; the
+    # sentinel must notice, and its episode latch must fire only once.
+    injector = get_fault_injector()
+    try:
+        injector.arm(
+            'slow_window', times=10**6, delay_s=slow_delay_s, after=0
+        )
+        slow = run_loadgen(engine, workload)
+    finally:
+        injector.disarm()
+    history.sample_once()
+    sentinel_slow = RegressionSentinel(
+        history, envelope=envelope, threshold=0.5,
+        window_s=slow.elapsed_s + 2.0 * sample_interval_s,
+    )
+    slow_fired = sentinel_slow.evaluate()
+    slow_refired = sentinel_slow.evaluate()  # latched: must be empty
+
+    # Overload arm (a): a hopeless TTFT SLO with admission OFF — every
+    # arrival is served and judged, so the misses flow into
+    # ``distllm_request_slo_total`` and the 60 s burn gauge must move.
+    engine.config.ttft_slo_s = overload_slo_s
+    overload = run_loadgen(engine, build_workload(overload_cfg))
+    history.sample_once()
+    burns = update_burn_gauges(history)
+    verdict = slo_status(history)['verdict']
+    # Overload arm (b): the same schedule with admission control ON —
+    # the shed path under the same pressure (informational, like
+    # gen_chaos: shed volume is offered-load policy, not quality).
+    engine.admission_control = True
+    shed_run = run_loadgen(engine, build_workload(overload_cfg))
+    engine.admission_control = False
+    engine.config.ttft_slo_s = slo_s
+
+    sampler.stop()
+    leaked = any(
+        t.name == SAMPLER_THREAD_NAME for t in threading.enumerate()
+    )
+
+    out = {
+        f'{prefix}metric': 'live history + sentinel + burn rates under '
+                           'real traffic',
+        f'{prefix}tok_s': round(clean.achieved_tok_s, 2),
+        f'{prefix}ttft_p95': clean.percentiles.get('ttft_p95'),
+        f'{prefix}tpot_p95': clean.percentiles.get('tpot_p95'),
+        f'{prefix}goodput_tokens': clean.goodput_tokens,
+        f'{prefix}samples': history.samples,
+        f'{prefix}envelope_metrics': len(envelope['metrics']),
+        f'{prefix}tokens_identical': identical,
+        f'{prefix}clean_regressions': len(clean_fired),
+        f'{prefix}slow_regressions': len(slow_fired),
+        f'{prefix}slow_relatch_regressions': len(slow_refired),
+        f'{prefix}slow_tok_s': round(slow.achieved_tok_s, 2),
+        f'{prefix}slow_fired_metrics': sorted(
+            e['metric'] for e in slow_fired
+        ),
+        f'{prefix}burn_60s': round(burns['60s'], 3),
+        f'{prefix}slo_verdict': verdict,
+        f'{prefix}overload_slo_missed': overload.slo_missed,
+        f'{prefix}shed_requests': shed_run.shed_requests,
+        f'{prefix}sampler_leaked': leaked,
+        f'{prefix}warmup_secs': round(warmup_secs, 1),
+        f'{prefix}device': str(jax.devices()[0].device_kind),
+        f'{prefix}workload': _workload_fingerprint(
+            {
+                'arrivals': [
+                    [a.at_s, list(a.prompt_ids), a.max_tokens, a.session]
+                    for a in workload
+                ],
+                'engine': {'max_num_seqs': max_num_seqs,
+                           'num_blocks': num_blocks,
+                           'decode_steps': decode_steps},
+                'slow_delay_s': slow_delay_s,
+            }
+        ),
+        **_cache_fields(prefix, cache_before),
+    }
+    if not envelope['metrics']:
+        out[f'{prefix}error'] = (
+            'clean arm produced an empty baseline envelope — the shared '
+            'extraction found none of its own stage keys'
+        )
+    elif not identical:
+        out[f'{prefix}error'] = (
+            'history on/off token mismatch — sampling must be pure '
+            'observation (greedy fp32), it may never perturb serving'
+        )
+    elif clean_fired:
+        out[f'{prefix}error'] = (
+            f'sentinel fired {len(clean_fired)} regression(s) on a run '
+            'statistically identical to its own baseline: '
+            f'{[e["metric"] for e in clean_fired]}'
+        )
+    elif not slow_fired:
+        out[f'{prefix}error'] = (
+            'slow_window fault collapsed throughput '
+            f'({clean.achieved_tok_s:.1f} -> {slow.achieved_tok_s:.1f} '
+            'tok/s) but the sentinel never fired'
+        )
+    elif slow_refired:
+        out[f'{prefix}error'] = (
+            'sentinel re-fired on a latched degradation episode — '
+            'once-per-episode alarm discipline is broken'
+        )
+    elif not overload.slo_missed:
+        out[f'{prefix}error'] = (
+            'overload arm recorded zero SLO misses — the burn-rate '
+            'check below would be vacuous'
+        )
+    elif burns['60s'] <= 0:
+        out[f'{prefix}error'] = (
+            f'{overload.slo_missed} SLO misses but the 60s burn-rate '
+            'gauge never moved off zero'
+        )
+    elif leaked:
+        out[f'{prefix}error'] = (
+            'a sampler thread is still alive after stop() — the '
+            'shutdown contract leaks threads'
+        )
+    if fallback_reason:
+        out[f'{prefix}attn_fallback_reason'] = fallback_reason
+    return out
+
+
 def _stage_gen_kvq() -> dict:
     """Quantized-KV-cache A/B (docs/serving.md "Quantized KV cache"): the
     SAME staggered greedy workload (the gen_mixed shape — shared-prefix
@@ -1981,7 +2258,8 @@ def _chip_peak_flops(device) -> float | None:
 # expensive coverage first, never the headline metrics.
 STAGE_ORDER = (
     'embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_spec',
-    'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos', 'gen_kvq', 'gen_q',
+    'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos', 'gen_history',
+    'gen_kvq', 'gen_q',
 )
 NOMINAL_BUDGET_S = {
     'embed': 1200.0,
@@ -1994,12 +2272,13 @@ NOMINAL_BUDGET_S = {
     'gen_load': 2700.0,
     'gen_tier': 2700.0,
     'gen_chaos': 2700.0,
+    'gen_history': 2700.0,
     'gen_kvq': 2700.0,
     'gen_q': 2700.0,
 }
 GEN_STAGES = frozenset(
     {'gen', 'gen_q', 'gen_prefix', 'gen_mixed', 'gen_spec', 'gen_kernel',
-     'gen_load', 'gen_tier', 'gen_chaos', 'gen_kvq'}
+     'gen_load', 'gen_tier', 'gen_chaos', 'gen_history', 'gen_kvq'}
 )
 # Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
 # stages stop with ~5 min to spare even if the guess is exact, and the
@@ -2247,6 +2526,7 @@ def _run_stage_entry(stage: str) -> None:
         'gen_load': _stage_gen_load,
         'gen_tier': _stage_gen_tier,
         'gen_chaos': _stage_gen_chaos,
+        'gen_history': _stage_gen_history,
         'gen_kvq': _stage_gen_kvq,
     }
     watchdog = None
@@ -2273,7 +2553,7 @@ def main() -> None:
         choices=[
             'embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix', 'gen_mixed',
             'gen_spec', 'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos',
-            'gen_kvq',
+            'gen_history', 'gen_kvq',
         ],
     )
     args = parser.parse_args()

@@ -26,9 +26,21 @@ Observability surface (docs/observability.md):
 - ``GET /debug/perfetto?limit=N`` — the flight + span rings rendered as a
   Perfetto/``chrome://tracing`` trace-event JSON (open it at
   https://ui.perfetto.dev), request-id-correlated tracks included;
+- ``GET /debug/history?limit=N&prefix=...`` — the metric-history ring
+  (``observability/history.py``, ``distllm-history/v1`` schema): retained
+  counter rates / gauge values / histogram quantile snapshots, sampled
+  every ``DISTLLM_HISTORY_S`` seconds (default 1; 0 disables the
+  sampler) by a background thread started with the app and stopped on
+  cleanup;
+- ``GET /debug/slo`` — the ``slo_status()`` ok/warn/page document
+  (multi-window burn rates over ``distllm_request_slo_total``) plus the
+  regression-sentinel state; arm the sentinel with
+  ``DISTLLM_BASELINE=<envelope path>`` (written by
+  ``scripts/benchdiff.py --emit-baseline``) — a missing baseline is a
+  counted disarm, never a startup failure;
 - ``GET /debug/bundle`` — dump a full debug bundle (flight ring + metrics
-  + traces + perfetto.json + startup.json) to disk and return the written
-  paths;
+  + traces + perfetto.json + startup.json + history.json + slo.json) to
+  disk and return the written paths;
 - ``GET /debug/xprof?seconds=N`` — bounded on-demand ``jax.profiler``
   capture to disk (one at a time; errors reported, never fatal).
 
@@ -73,14 +85,19 @@ import distllm_tpu
 from distllm_tpu.chat import ChatAppConfig, ChatSession
 from distllm_tpu.resilience import EngineOverloaded
 from distllm_tpu.observability import (
+    HistorySampler,
     StallWatchdog,
     dump_debug_bundle,
     get_flight_recorder,
+    get_metrics_history,
     get_profiler_capture,
     get_trace_buffer,
+    install_regression_sentinel,
+    install_slo_observer,
     instruments,
     render_prometheus,
     request_scope,
+    slo_status,
     span,
     to_trace_events,
 )
@@ -148,6 +165,42 @@ def build_app(config: ChatAppConfig):
     known_paths = ('/v1/chat/completions', '/health', '/metrics', '/drain')
     for path in known_paths:
         instruments.HTTP_LATENCY.labels(path=path)
+
+    # Continuous telemetry (docs/observability.md "Metric history"): one
+    # background sampler folds the registry into the history ring every
+    # DISTLLM_HISTORY_S seconds (default 1; 0/negative disables). The
+    # SLO burn-rate observer and the regression sentinel ride the same
+    # tick. The server owns the process sampler — engines only start
+    # their own when EngineConfig.history_interval_s asks for one.
+    instruments.SERVER_UPTIME.set(0.0)
+    history = get_metrics_history()
+    slo_observer = install_slo_observer(history)
+    sentinel = install_regression_sentinel(
+        history, baseline_path=os.environ.get('DISTLLM_BASELINE') or None
+    )
+
+    def _uptime_observer(h, now):
+        instruments.SERVER_UPTIME.set(max(0.0, now - started_at))
+
+    history.add_observer(_uptime_observer)
+    history_interval_s = float(os.environ.get('DISTLLM_HISTORY_S', '1') or 0)
+    sampler = (
+        HistorySampler(history, interval_s=history_interval_s)
+        if history_interval_s > 0
+        else None
+    )
+    if sampler is not None:
+        sampler.start()
+
+    async def _stop_history(app) -> None:
+        # on_cleanup: join the sampler thread (no leak after shutdown —
+        # asserted by tests) and detach this app's observers so a later
+        # build_app in the same process doesn't double-tick them.
+        if sampler is not None:
+            sampler.stop()
+        history.remove_observer(_uptime_observer)
+        history.remove_observer(slo_observer)
+        sentinel.uninstall()
 
     # Drain lifecycle (docs/resilience.md): POST /drain flips this, new
     # completions get 503 + Retry-After while in-flight ones finish, and
@@ -307,6 +360,7 @@ def build_app(config: ChatAppConfig):
         # In-flight includes this very request; report the others.
         in_flight = max(0, int(instruments.HTTP_IN_FLIGHT.value) - 1)
         draining = state['draining']
+        instruments.SERVER_UPTIME.set(max(0.0, time.time() - started_at))
         # Readiness for the multi-replica router (ROADMAP item 2): the
         # body carries the flag AND the status code flips to 503 while
         # draining, so both field-readers and code-readers route away.
@@ -428,6 +482,7 @@ def build_app(config: ChatAppConfig):
                     for s in get_trace_buffer().snapshot(limit=limit)
                     if s.end_ns is not None
                 ],
+                history=history.snapshot(limit=limit),
             )
             return json.dumps(doc)
 
@@ -436,6 +491,30 @@ def build_app(config: ChatAppConfig):
         return web.Response(
             body=body.encode('utf-8'),
             headers={'Content-Type': 'application/json'},
+        )
+
+    async def history_endpoint(request: 'web.Request') -> 'web.Response':
+        """``GET /debug/history?limit=N&prefix=...`` — the retained
+        metric history (``distllm-history/v1`` schema; limit trims each
+        series to its newest N points, default 120)."""
+        try:
+            limit = int(request.query.get('limit', '120'))
+        # distlint: disable=swallowed-exception -- input validation surfaced to the client as a 400 and counted by the HTTP middleware's status-class metric
+        except ValueError:
+            return web.json_response(
+                {'error': {'message': 'limit must be an integer'}}, status=400
+            )
+        prefix = request.query.get('prefix') or None
+        doc = history.snapshot(limit=max(1, limit), prefix=prefix)
+        doc['sampler_running'] = bool(sampler is not None and sampler.running)
+        return web.json_response(doc)
+
+    async def slo_endpoint(request: 'web.Request') -> 'web.Response':
+        """``GET /debug/slo`` — burn-rate verdict + sentinel state (the
+        per-replica signal feed for the multi-replica router)."""
+        instruments.SERVER_UPTIME.set(max(0.0, time.time() - started_at))
+        return web.json_response(
+            {**slo_status(history), 'sentinel': sentinel.status()}
         )
 
     async def bundle(request: 'web.Request') -> 'web.Response':
@@ -523,10 +602,13 @@ def build_app(config: ChatAppConfig):
     app.router.add_get('/debug/traces', traces)
     app.router.add_get('/debug/flight', flight)
     app.router.add_get('/debug/perfetto', perfetto)
+    app.router.add_get('/debug/history', history_endpoint)
+    app.router.add_get('/debug/slo', slo_endpoint)
     app.router.add_get('/debug/bundle', bundle)
     app.router.add_get('/debug/xprof', xprof)
     # Browser preflight for any path (CORS headers added by the middleware).
     app.router.add_route('OPTIONS', '/{tail:.*}', preflight)
+    app.on_cleanup.append(_stop_history)
     return app
 
 

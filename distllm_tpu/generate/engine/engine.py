@@ -255,7 +255,9 @@ class EngineConfig(BaseConfig):
             raise ValueError(f'{info.field_name} must be >= 0')
         return v
 
-    @field_validator('request_deadline_s', 'retry_backoff_s')
+    @field_validator(
+        'request_deadline_s', 'retry_backoff_s', 'history_interval_s'
+    )
     @classmethod
     def _non_negative_seconds(cls, v: float, info) -> float:
         if v < 0:
@@ -481,6 +483,16 @@ class EngineConfig(BaseConfig):
     # stay (nanoseconds — gating them would complicate every window path
     # for nothing measurable).
     attribution: bool = True
+    # Metric-history sampler (docs/observability.md "Metric history &
+    # sampling"): > 0 makes THIS engine own a background
+    # ``HistorySampler`` ticking the process-wide ``MetricsHistory`` at
+    # the given interval, started in ``__init__`` and stopped in
+    # ``shutdown()`` (no leaked thread — tested). 0 (default) starts
+    # nothing: the chat server owns the process sampler in serving
+    # deployments, and two samplers over one history would double the
+    # sample density for no information. Set it only for headless /
+    # scripted engines that want history without a server.
+    history_interval_s: float = 0.0
     seed: int = 0
 
     @field_validator('spec_draft_source')
@@ -642,6 +654,21 @@ class LLMEngine:
         # (_record_step feeds them; roofline floors cover cold start).
         self.admission_control = cfg.admission_control
         self._ewma: dict[str, float] = {}
+        # Metric-history sampler, engine-owned ONLY when configured
+        # (history_interval_s > 0); serving deployments leave this 0 and
+        # let the chat server own the process sampler. Stopped (and the
+        # thread joined) in shutdown() — never leaks past the engine.
+        self._history_sampler = None
+        if cfg.history_interval_s > 0:
+            from distllm_tpu.observability.history import (
+                HistorySampler,
+                get_metrics_history,
+            )
+
+            self._history_sampler = HistorySampler(
+                get_metrics_history(), interval_s=cfg.history_interval_s
+            )
+            self._history_sampler.start()
 
         model = self.model_cfg
 
@@ -4243,6 +4270,9 @@ class LLMEngine:
         return [self.tokenizer.decode(out) for out in outputs]
 
     def shutdown(self) -> None:
+        if self._history_sampler is not None:
+            self._history_sampler.stop()
+            self._history_sampler = None
         self.params = None
         self.kv = None
 

@@ -28,7 +28,20 @@ Three layers, all dependency-free:
   calibration ratios;
 - :mod:`~distllm_tpu.observability.profiling` — the bounded
   ``jax.profiler`` capture helper (``GET /debug/xprof``,
-  ``DISTLLM_BENCH_PROFILE``).
+  ``DISTLLM_BENCH_PROFILE``);
+- :mod:`~distllm_tpu.observability.history` — the bounded metric-history
+  ring + background sampler (ISSUE 18 tentpole): retained time series
+  over the live registry (``GET /debug/history``, ``history.json`` in
+  bundles, the Perfetto ``history`` counter track);
+- :mod:`~distllm_tpu.observability.slo` — multi-window multi-burn-rate
+  SLO engine over the history (``distllm_slo_burn_rate{window}``,
+  ``slo_status()`` ok/warn/page, ``GET /debug/slo``);
+- :mod:`~distllm_tpu.observability.baseline` — BENCH-record parsing +
+  the baseline envelope, shared with ``scripts/benchdiff.py`` so the
+  offline gate and the runtime sentinel can never disagree on parsing;
+- :mod:`~distllm_tpu.observability.sentinel` — the runtime regression
+  sentinel: live history windows vs the baseline envelope, firing the
+  ``regression`` flight kind + ``distllm_sentinel_regressions_total``.
 
 ``aggregate`` (imported lazily to avoid a cycle with ``timer``) rolls
 multi-host ``[timer]`` logs into one stats table. Metric names and
@@ -37,6 +50,11 @@ conventions are documented in ``docs/observability.md``.
 
 from __future__ import annotations
 
+from distllm_tpu.observability.baseline import (
+    build_envelope,
+    envelope_from_records,
+    load_envelope,
+)
 from distllm_tpu.observability.flight import (
     Deadline,
     FlightRecorder,
@@ -44,6 +62,12 @@ from distllm_tpu.observability.flight import (
     StallWatchdog,
     dump_debug_bundle,
     get_flight_recorder,
+)
+from distllm_tpu.observability.history import (
+    HistorySampler,
+    MetricsHistory,
+    get_metrics_history,
+    history_excerpt,
 )
 from distllm_tpu.observability.instruments import log_event
 from distllm_tpu.observability.metrics import (
@@ -66,6 +90,16 @@ from distllm_tpu.observability.profiling import (
     get_profiler_capture,
 )
 from distllm_tpu.observability.roofline import CostModel, device_peaks
+from distllm_tpu.observability.sentinel import (
+    RegressionSentinel,
+    get_regression_sentinel,
+    install_regression_sentinel,
+)
+from distllm_tpu.observability.slo import (
+    install_slo_observer,
+    slo_status,
+    update_burn_gauges,
+)
 from distllm_tpu.observability.startup import (
     CompileWatcher,
     get_compile_watcher,
@@ -92,24 +126,35 @@ __all__ = [
     'FlightRecorder',
     'Gauge',
     'Histogram',
+    'HistorySampler',
+    'MetricsHistory',
     'MetricsRegistry',
     'ProfilerCapture',
+    'RegressionSentinel',
     'RunRecord',
     'Span',
     'StallWatchdog',
     'TraceBuffer',
     'XlaCost',
     'begin_span',
+    'build_envelope',
     'current_request_id',
     'device_peaks',
     'dump_debug_bundle',
     'dump_traces',
     'end_span',
+    'envelope_from_records',
     'get_compile_watcher',
     'get_flight_recorder',
+    'get_metrics_history',
     'get_profiler_capture',
     'get_registry',
+    'get_regression_sentinel',
     'get_trace_buffer',
+    'history_excerpt',
+    'install_regression_sentinel',
+    'install_slo_observer',
+    'load_envelope',
     'log_buckets',
     'log_event',
     'merge_host_traces',
@@ -118,7 +163,9 @@ __all__ = [
     'record_backend_init',
     'render_prometheus',
     'request_scope',
+    'slo_status',
     'span',
     'to_trace_events',
+    'update_burn_gauges',
     'validate_trace_events',
 ]

@@ -132,7 +132,10 @@ def dump_debug_bundle(
     (Prometheus exposition snapshot), ``traces.jsonl`` (span ring),
     ``startup.json`` (compile-phase records + the phase currently in
     progress + profiler-capture state — an init-stall bundle names the
-    dead phase instead of arriving empty), ``meta.json``
+    dead phase instead of arriving empty), ``history.json`` (the
+    metric-history ring: the minutes BEFORE the incident, not just the
+    final values), ``slo.json`` (burn-rate status + regression-sentinel
+    state), ``meta.json``
     (reason/pid/time/extra), and — best-effort, when a JAX backend is
     initialized and supports it — ``device_memory.prof``
     (``jax.profiler.save_device_memory_profile``). Every piece is written
@@ -183,17 +186,57 @@ def dump_debug_bundle(
         paths['startup'] = str(startup_path)
     except Exception:
         pass
+    # Metric history + SLO/sentinel state: the time-resolved twin of the
+    # instantaneous metrics.prom snapshot — a bundle dumped mid-incident
+    # shows the minutes BEFORE the stall, not just the final values.
+    # Lazy imports (history/slo/sentinel import instruments, which sits
+    # beside this module in the package).
+    history_path = directory / 'history.json'
+    try:
+        from distllm_tpu.observability.history import get_metrics_history
+
+        history_path.write_text(
+            json.dumps(get_metrics_history().snapshot(), default=str)
+        )
+        paths['history'] = str(history_path)
+    except Exception:
+        pass
+    slo_path = directory / 'slo.json'
+    try:
+        from distllm_tpu.observability.history import get_metrics_history
+        from distllm_tpu.observability.sentinel import (
+            get_regression_sentinel,
+        )
+        from distllm_tpu.observability.slo import slo_status
+
+        sentinel = get_regression_sentinel()
+        slo_path.write_text(
+            json.dumps(
+                {
+                    'slo': slo_status(get_metrics_history()),
+                    'sentinel': (
+                        sentinel.status() if sentinel is not None else None
+                    ),
+                },
+                default=str,
+            )
+        )
+        paths['slo'] = str(slo_path)
+    except Exception:
+        pass
     # Perfetto/Chrome trace of the same state: drop flight.jsonl's raw
     # rings into https://ui.perfetto.dev without any conversion step —
     # the post-mortem view of where the dying process's time went.
     perfetto_path = directory / 'perfetto.json'
     try:
+        from distllm_tpu.observability.history import get_metrics_history
         from distllm_tpu.observability.perfetto import dump_trace
 
         dump_trace(
             perfetto_path,
             recorder.snapshot(),
             [s.to_dict() for s in get_trace_buffer().snapshot()],
+            history=get_metrics_history(),
         )
         paths['perfetto'] = str(perfetto_path)
     except Exception:

@@ -12,6 +12,7 @@ suffix on counters, base units (seconds, bytes, ratios in [0, 1]).
 
 from __future__ import annotations
 
+from distllm_tpu import __version__
 from distllm_tpu.observability.metrics import get_registry, log_buckets
 
 _registry = get_registry()
@@ -429,6 +430,9 @@ FLIGHT_KINDS = frozenset({
                    # (reason=dispatch_failed|timeout, recorded error)
     'shed',     # a request refused at admission (predicted_ttft_s /
                 # retry_after_s — the honest-backpressure record)
+    'regression',  # runtime sentinel firing: a live history window
+                   # degraded past threshold vs the BENCH baseline
+                   # envelope (metric/baseline/live/window_s fields)
 })
 
 # Catalog of startup/compile phase kinds (observability/startup.py),
@@ -468,6 +472,9 @@ TRACE_EVENT_CATEGORIES = frozenset({
     'request',       # per-request lifecycle slice + nested ttft/queue_wait
     'span',          # trace-ring spans (server middleware, RAG, stages)
     'startup',       # compile-phase slices on the dedicated startup track
+    'history',       # metric-history counter track (ph "C" events from
+                     # the history.py ring: tok/s, burn rates, queue
+                     # depth, KV occupancy over the trace window)
 })
 
 # ------------------------------------------------- resilience / fault layer
@@ -532,6 +539,95 @@ SERVER_READY = _registry.gauge(
     '"ready" field and a 503 status while draining.',
 )
 SERVER_READY.set(1.0)
+
+# ------------------------------------------------ build identity / uptime
+# Standard fleet-observability identities (the multi-replica router and
+# aggregate tooling key on them): a constant-1 info gauge carrying the
+# package version label, and a seconds-since-boot gauge the chat server
+# refreshes on every history tick and health probe.
+BUILD_INFO = _registry.gauge(
+    'distllm_build_info',
+    'Constant 1 with the package version as a label — the standard '
+    'build-identity series fleet tooling joins per-replica metrics on.',
+    labelnames=('version',),
+)
+BUILD_INFO.labels(version=__version__).set(1.0)
+SERVER_UPTIME = _registry.gauge(
+    'distllm_server_uptime_seconds',
+    'Seconds since this chat_server process built its app (refreshed on '
+    'every history-sampler tick and /health probe; 0 until a server runs).',
+)
+
+# ------------------------------------- telemetry history (history.py ring)
+HISTORY_SAMPLES = _registry.counter(
+    'distllm_history_samples_total',
+    'Completed history-sampler ticks (observability/history.py) — one '
+    'full registry snapshot folded into the bounded time-series ring.',
+)
+HISTORY_SAMPLE_SECONDS = _registry.histogram(
+    'distllm_history_sample_duration_seconds',
+    'Wall time per history sampling tick — the overhead bound: '
+    'tests/test_history.py asserts a full-catalog tick stays under 50 ms '
+    '(typically well under 5 ms), so a 1 s sampling interval costs <1% '
+    'of one core.',
+    buckets=log_buckets(1e-5, 1.0),
+)
+HISTORY_SAMPLE_ERRORS = _registry.counter(
+    'distllm_history_sample_errors_total',
+    'History observer callbacks that raised (swallowed and counted — a '
+    'broken SLO/sentinel observer must not kill the sampler thread).',
+)
+
+# --------------------------------------- SLO burn rate (observability/slo.py)
+# The burn-rate windows, as label values ('<seconds>s'). This tuple is the
+# single owner: slo.py derives its short/long window pairs from it and the
+# gauge pre-registration below iterates it, so a new window cannot leave
+# the scrape schema behind. Default pairing (SRE-workbook style): the fast
+# pair (60s short, 600s long) pages, the slow pair (300s, 3600s) warns.
+SLO_BURN_WINDOW_LABELS = ('60s', '300s', '600s', '3600s')
+SLO_BURN_RATE = _registry.gauge(
+    'distllm_slo_burn_rate',
+    'TTFT-SLO error-budget burn rate per trailing window: '
+    '(missed / finished in the window) / (1 - objective). 1.0 = burning '
+    'exactly the budget; sustained >> 1 on both windows of a pair pages '
+    '(docs/observability.md "SLO burn rates").',
+    labelnames=('window',),
+)
+for _window in SLO_BURN_WINDOW_LABELS:
+    SLO_BURN_RATE.labels(window=_window)
+
+# --------------------------- runtime regression sentinel (sentinel.py)
+# The live metrics the sentinel compares against the baseline envelope
+# (scripts/benchdiff.py --emit-baseline). Single owner: sentinel.py's
+# live-extractor table and the counter pre-registration both iterate it.
+SENTINEL_METRIC_LABELS = (
+    'tok_s', 'ttft_p95_s', 'tpot_p95_s', 'mfu_measured', 'bw_util_measured',
+)
+SENTINEL_REGRESSIONS = _registry.counter(
+    'distllm_sentinel_regressions_total',
+    'Live-window regressions detected by the runtime sentinel, by '
+    'baseline metric: a trailing history window degraded past the '
+    'sentinel threshold vs the BENCH baseline envelope. One count per '
+    'degradation episode (latched until the metric recovers).',
+    labelnames=('metric',),
+)
+for _metric in SENTINEL_METRIC_LABELS:
+    SENTINEL_REGRESSIONS.labels(metric=_metric)
+SENTINEL_ARMED = _registry.gauge(
+    'distllm_sentinel_armed',
+    '1 while the regression sentinel holds a baseline envelope with at '
+    'least one comparable metric, 0 while disarmed (no baseline — the '
+    'counted degraded mode, never a raise).',
+)
+SENTINEL_DISARMED = _registry.counter(
+    'distllm_sentinel_disarmed_total',
+    'Sentinel arm attempts that degraded to disarmed, by reason: '
+    'no_baseline = envelope file missing/unreadable, empty = envelope '
+    'parsed but carried no comparable metrics.',
+    labelnames=('reason',),
+)
+for _reason in ('no_baseline', 'empty'):
+    SENTINEL_DISARMED.labels(reason=_reason)
 
 # -------------------------------------------------- watchdog / debug bundle
 WATCHDOG_STALLS = _registry.counter(

@@ -393,6 +393,45 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def collect(self) -> list[dict]:
+        """Structured snapshot of every instrument — the machine-readable
+        twin of :meth:`render`, consumed by the history sampler
+        (``history.py``) so it never has to re-parse exposition text.
+
+        One dict per family: ``{'name', 'kind', 'labelnames',
+        'children': [...]}``. Each child carries its label values plus
+        ``value`` (counter/gauge) or ``buckets``/``cumulative``/``sum``
+        (histogram, cumulative counts per the exposition contract).
+        Per-child reads take the child locks; the snapshot is coherent
+        per-child, not across the whole registry — the same guarantee a
+        text scrape gives.
+        """
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        families: list[dict] = []
+        for metric in metrics:
+            children = []
+            for labelvalues, child in metric.children():
+                if isinstance(child, _HistogramChild):
+                    children.append({
+                        'labels': labelvalues,
+                        'buckets': child.buckets,
+                        'cumulative': child.cumulative_counts(),
+                        'sum': child.sum,
+                    })
+                else:
+                    children.append({
+                        'labels': labelvalues,
+                        'value': child.value,
+                    })
+            families.append({
+                'name': metric.name,
+                'kind': metric.kind,
+                'labelnames': metric.labelnames,
+                'children': children,
+            })
+        return families
+
     def unregister(self, name: str) -> None:
         with self._lock:
             self._metrics.pop(name, None)

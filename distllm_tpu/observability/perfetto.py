@@ -22,7 +22,11 @@ did the time go" becomes a scroll instead of a probe-script investigation:
   TTFT and queue-wait slices;
 - server/application spans from the trace ring: spans stamped with a
   ``request_id`` land on that request's track (server → engine
-  correlation in one glance), the rest on per-thread tracks.
+  correlation in one glance), the rest on per-thread tracks;
+- a ``history`` group of counter tracks (ph ``'C'``) rendered from the
+  metric-history ring (``observability/history.py``) — tok/s, SLO burn
+  rates, queue depth, KV occupancy — so the load/latency shape scrubs
+  alongside the slices instead of living in a separate JSON document.
 
 Served at ``GET /debug/perfetto`` by the chat server, written as
 ``perfetto.json`` into every debug bundle, and merged across hosts by
@@ -50,8 +54,22 @@ _KIND_TIDS = {'prefill': 1, 'decode': 2, 'mixed': 3, 'spec': 4}
 _STARTUP_TID = 8
 _HOST_TID = 9
 _EVENT_TID = 10
+_HISTORY_TID = 11
 _REQUEST_TID_BASE = 100
 _THREAD_TID_BASE = 10_000
+
+# Metric-history series rendered as Perfetto counter tracks (ph 'C'):
+# (history series key, counter track name, value column in the rendered
+# snapshot points — counters are [t, delta, rate], gauges [t, value]).
+# A curated subset, not the whole ring: the load/latency shape an
+# incident reader scrubs the trace against.
+_HISTORY_TRACK_SERIES = (
+    ('distllm_engine_generated_tokens_total', 'tok/s', 2),
+    ('distllm_slo_burn_rate{window=60s}', 'slo_burn:60s', 1),
+    ('distllm_slo_burn_rate{window=600s}', 'slo_burn:600s', 1),
+    ('distllm_scheduler_queue_depth', 'queue_depth', 1),
+    ('distllm_kv_cache_occupancy_ratio', 'kv_occupancy', 1),
+)
 
 # Flight fields that become their own event structure rather than args.
 _STEP_META = ('kind', 't_wall', 'duration_s')
@@ -126,12 +144,17 @@ def to_trace_events(
     pid: int = 1,
     process_name: str = 'distllm',
     time_origin_s: float | None = None,
+    history=None,
 ) -> dict:
     """Render flight records + span dicts into a Chrome trace-event doc.
 
     ``flight_records`` are ``FlightRecorder.snapshot()`` dicts (or parsed
     ``flight.jsonl`` lines); ``spans`` are ``Span.to_dict()`` dicts (or
-    parsed ``traces.jsonl`` lines). Returns
+    parsed ``traces.jsonl`` lines); ``history`` (optional) is a
+    ``MetricsHistory`` or its ``snapshot()`` document, rendered as
+    counter tracks (ph ``'C'``, the ``history`` category) for the
+    curated ``_HISTORY_TRACK_SERIES`` — tok/s, burn rates, queue depth,
+    KV occupancy over the trace window. Returns
     ``{'traceEvents': [...], 'displayTimeUnit': 'ms'}`` with every track's
     events in non-decreasing ``ts`` order — the invariant the exporter
     tests pin. Unknown/torn records are skipped, never fatal: this runs
@@ -262,6 +285,41 @@ def to_trace_events(
             name, us(float(wall)), float(duration) * 1e6, pid, tid,
             args, cat='span',
         ))
+
+    # ---- metric-history counter tracks ---------------------------------
+    if history is not None:
+        snap = history if isinstance(history, dict) else history.snapshot()
+        hist_series = snap.get('series', {}) if isinstance(snap, dict) else {}
+        emitted_any = False
+        for key, track_name, value_index in _HISTORY_TRACK_SERIES:
+            entry = hist_series.get(key)
+            if not isinstance(entry, dict):
+                continue
+            for point in entry.get('points', ()):
+                try:
+                    t_point = float(point[0])
+                    value = point[value_index]
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if isinstance(value, bool) or not isinstance(
+                    value, (int, float)
+                ):
+                    continue
+                emitted_any = True
+                events.append({
+                    'name': track_name,
+                    'cat': 'history',
+                    'ph': 'C',
+                    'ts': round(us(t_point), 3),
+                    'pid': pid,
+                    'tid': _HISTORY_TID,
+                    'args': {'value': round(float(value), 6)},
+                })
+        if emitted_any:
+            meta.append(_meta(
+                'thread_name', 'history (metric counters)',
+                pid, _HISTORY_TID,
+            ))
 
     for kind, tid in sorted(_KIND_TIDS.items(), key=lambda kv: kv[1]):
         meta.append(_meta('thread_name', f'engine:{kind}', pid, tid))

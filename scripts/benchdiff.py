@@ -25,6 +25,15 @@ Usage::
     python scripts/benchdiff.py BENCH_r01.json BENCH_r02.json
     python scripts/benchdiff.py BENCH_r*.json --markdown TRAJECTORY.md
     python scripts/benchdiff.py r02.json candidate.json --threshold 0.03
+    python scripts/benchdiff.py BENCH_r*.json --emit-baseline baseline.json
+
+``--emit-baseline`` distills the newest record that carried metrics into
+the **baseline envelope** the runtime regression sentinel consumes
+(``distllm_tpu/observability/sentinel.py``; arm a server with
+``DISTLLM_BASELINE=<path>``). Record parsing and gate directions live in
+``distllm_tpu.observability.baseline`` — SHARED with the sentinel, so
+the offline gate and the runtime sentinel can never disagree on what a
+record says; this script re-exports them for its library consumers.
 
 Runs in the fast test tier over the real r01/r02 records
 (``tests/test_benchdiff.py``); dependency-free (no jax import).
@@ -38,110 +47,26 @@ import math
 import sys
 from pathlib import Path
 
-# Direction of "better" per gated metric. Matching is by substring /
-# suffix on the flattened key; anything unmatched is informational only
-# (shown in the table, never gated) — counts, batch sizes, cache-entry
-# bookkeeping must not fail a round. 'mfu_measured' / 'bw_util_measured'
-# gate the per-kind XLA-measured roofline columns the gen_kernel A/B
-# stage records (gen_kernel_{xla,pallas}_{mfu,bw_util}_measured,
-# docs/observability.md "Measured vs analytic MFU") so a kernel
-# regression — measured utilization falling on the same workload — trips
-# the trajectory gate even when tok/s noise hides it.
-_LOWER_BETTER_TOKENS = ('ttft', 'tpot', 'queue_wait', 'warmup_secs')
-_HIGHER_BETTER_SUFFIXES = ('value', 'mfu', 'vs_baseline')
-# 'promotion_overlap' gates the gen_tier stage's KV-tier prefetch
-# efficiency (1 - blocking wait / promotion span, docs/prefix_caching.md
-# "Tier hierarchy"): overlap falling means host→device promotions stopped
-# hiding behind decode windows. The stage's warm-TTFT metrics gate
-# lower-better via the 'ttft' token (gen_tier_warm_ttft_s /
-# gen_tier_cold_ttft_s), and gen_tier_warm_ttft_speedup higher-better via
-# the 'speedup' override above, so a tier regression trips the gate from
-# either side. Raw spill/promotion COUNTS stay informational — workload-
-# dependent volume, not quality.
-#
-# 'recoveries' gates the gen_chaos stage (docs/resilience.md): fewer
-# recoveries on the SAME deterministic fault schedule means injected
-# faults stopped being survived — requests started failing (or the
-# schedule stopped firing) instead of retrying back to identical tokens.
-# Goodput-under-fault gates through the existing 'goodput' token
-# (gen_chaos_goodput_tokens). Shed counts/rates stay INFORMATIONAL by
-# design: shed volume is offered-load policy, not quality — a round that
-# sheds more under a heavier schedule is not a regression ('shed_rate'
-# deliberately matches no gated token).
-# 'greedy_match' gates the gen_kvq stage's ACCURACY arm (docs/serving.md
-# "Quantized KV cache"): the fraction of the int8-KV arm's greedy tokens
-# matching the bf16-KV arm's on the same workload. Falling match fraction
-# is a QUALITY regression — the compression got lossier — and trips the
-# trajectory gate exactly like a throughput fall; the stage records the
-# divergence rather than asserting it away, and this token is what keeps
-# that honesty enforceable round over round. Direction rule: higher is
-# better (1.0 = bit-identical streams), so the generic higher-better
-# machinery applies; a tolerance is the gate --threshold, not a
-# stage-side epsilon.
-_HIGHER_BETTER_TOKENS = (
-    'goodput', 'accept_rate', 'hit_rate', 'tok_s', 'mfu_measured',
-    'bw_util_measured', 'promotion_overlap', 'recoveries', 'greedy_match',
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from distllm_tpu.observability.baseline import (  # noqa: E402
+    envelope_from_records,
+    extract_metrics,
+    gate_direction,
+    load_record,
 )
 
-
-def gate_direction(key: str) -> str | None:
-    """``'higher'`` / ``'lower'`` for gated metrics, ``None`` for
-    informational ones. Lower-better tokens win ties (``gen_load_ttft_s``
-    is a latency even though the stage also reports values) — EXCEPT
-    ``speedup``, which outranks them: speedups are ratios-of-latencies
-    named after their numerator (``gen_prefix_ttft_speedup``,
-    ``gen_kernel_speedup``), so the 'ttft' substring alone would gate a
-    warm-start IMPROVEMENT as a regression."""
-    k = key.lower()
-    if 'speedup' in k:
-        return 'higher'
-    if any(token in k for token in _LOWER_BETTER_TOKENS):
-        return 'lower'
-    if k.endswith(_HIGHER_BETTER_SUFFIXES):
-        return 'higher'
-    if any(token in k for token in _HIGHER_BETTER_TOKENS):
-        return 'higher'
-    return None
-
-
-def extract_metrics(parsed) -> dict[str, float]:
-    """Numeric metrics from one record's parsed payload (flat dict in;
-    bools and non-numerics dropped; ``None``/missing payload → empty)."""
-    if not isinstance(parsed, dict):
-        return {}
-    out: dict[str, float] = {}
-    for key, value in parsed.items():
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
-            continue
-        # bench records round-trip NaN/inf through json (allow_nan): a
-        # degenerate 0/0 mfu must not crash the gate, and NaN compares
-        # False against every threshold — drop it as "not reported"
-        # rather than let it silently pass.
-        if not math.isfinite(value):
-            continue
-        out[key] = float(value)
-    return out
-
-
-def load_record(path: str | Path) -> dict:
-    """One record file → ``{'name', 'metrics', 'error'}``. Accepts the
-    driver-contract wrapper (``parsed`` payload) or a bare metrics
-    object; unreadable/unparseable files become an empty record with the
-    error noted — the gate must be able to diff across a crashed round."""
-    path = Path(path)
-    name = path.stem.replace('BENCH_', '')
-    try:
-        doc = json.loads(path.read_text())
-    except (OSError, json.JSONDecodeError) as exc:
-        return {'name': name, 'metrics': {}, 'error': repr(exc)[:200]}
-    payload = doc.get('parsed', doc) if isinstance(doc, dict) else None
-    metrics = extract_metrics(payload)
-    error = None
-    if isinstance(payload, dict) and payload.get('error'):
-        error = str(payload['error'])[:200]
-    elif not metrics:
-        error = 'no metrics in record (crashed before emitting?)'
-    return {'name': name, 'metrics': metrics, 'error': error}
+__all__ = [
+    'diff_records',
+    'envelope_from_records',
+    'extract_metrics',
+    'format_markdown',
+    'gate_direction',
+    'load_record',
+    'main',
+]
 
 
 def diff_records(
@@ -298,9 +223,28 @@ def main(argv: list[str] | None = None) -> int:
         help='treat gated metrics missing from the newest record as '
              'regressions (off by default: the r03-r05 tail is known-bad)',
     )
+    parser.add_argument(
+        '--emit-baseline', type=str, default=None, metavar='PATH',
+        help='write the baseline envelope (newest record with metrics) '
+             'for the runtime regression sentinel; works with any record '
+             'count — zero usable records emits an empty envelope the '
+             'sentinel disarms on (counted), never a crash',
+    )
     args = parser.parse_args(argv)
 
     records = [load_record(path) for path in args.records]
+    if args.emit_baseline is not None:
+        envelope = envelope_from_records(records)
+        Path(args.emit_baseline).write_text(
+            json.dumps(envelope, indent=2) + '\n'
+        )
+        print(
+            f'baseline envelope -> {args.emit_baseline} '
+            f'({len(envelope["metrics"])} metric(s) from '
+            f'{envelope["source"] or "no usable record"})'
+        )
+        if len(records) < 2:
+            return 0  # envelope-only invocation: nothing to diff
     if len(records) < 2:
         print('need at least two records to diff', file=sys.stderr)
         return 2

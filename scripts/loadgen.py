@@ -3,8 +3,11 @@
 Builds a randomly initialized Mistral-family engine at the requested dims,
 warms it, replays a deterministic seeded Poisson workload through
 ``distllm_tpu.generate.loadgen``, and prints one JSON report line:
-TTFT/TPOT/queue-wait p50/p95/p99, goodput, warm-prefix hits, and the
-per-window-kind MFU / bandwidth-utilization summary.
+TTFT/TPOT/queue-wait p50/p95/p99, goodput, warm-prefix hits, the
+per-window-kind MFU / bandwidth-utilization summary, and a compact
+metric-history excerpt (``loadgen_history_*``: the sampled tok/s series
+plus the SLO burn-rate gauges — docs/observability.md "Metric history &
+sampling").
 
 Examples::
 
@@ -56,6 +59,11 @@ def main(argv: list[str] | None = None) -> int:
         '--disk-tier-dir', type=str, default=None,
         help='optional disk KV tier directory (persists spilled blocks '
              'across engine restarts; needs --host-tier-bytes)')
+    parser.add_argument(
+        '--history-interval', type=float, default=0.5,
+        help='metric-history sampler tick, seconds; the report carries a '
+             'compact excerpt (tok/s series + burn-rate gauges) from the '
+             'retained history (docs/observability.md)')
     args = parser.parse_args(argv)
 
     import jax
@@ -117,9 +125,26 @@ def main(argv: list[str] | None = None) -> int:
     engine = LLMEngine(model_cfg, params, _Tok(), engine_cfg, own_params=True)
     engine.warmup()
 
+    # The CLI owns the process history sampler for the run (the scripted-
+    # run ownership convention, docs/observability.md), so the report can
+    # carry a time-resolved excerpt, not just end-of-run aggregates.
+    from distllm_tpu.observability.history import (
+        HistorySampler,
+        get_metrics_history,
+        history_excerpt,
+    )
+    from distllm_tpu.observability.slo import install_slo_observer
+
+    history = get_metrics_history()
+    slo_observer = install_slo_observer(history)
     workload = build_workload(load_cfg)
-    report = run_loadgen(engine, workload)
+    with HistorySampler(history, interval_s=args.history_interval):
+        report = run_loadgen(engine, workload)
+        history.sample_once()  # fold the tail before the excerpt reads
+    history.remove_observer(slo_observer)
     fragment = report.to_fragment('loadgen_')
+    for key, value in history_excerpt(history).items():
+        fragment[f'loadgen_history_{key}'] = value
     fragment['loadgen_device'] = str(jax.devices()[0].device_kind)
     if engine.kv_tier is not None:
         for key, value in engine.tier_summary().items():

@@ -188,6 +188,51 @@ def test_gen_kvq_gate_directions():
     )
 
 
+def test_gen_history_gate_directions():
+    """ISSUE 18: the telemetry stage's throughput/latency arms gate like
+    every other serving stage; sentinel fire counts, burn rates and shed
+    volume stay informational — they are schedule/policy facts, and the
+    stage itself errors when the slow arm fails to fire."""
+    assert benchdiff.gate_direction('gen_history_tok_s') == 'higher'
+    assert benchdiff.gate_direction('gen_history_ttft_p95') == 'lower'
+    assert benchdiff.gate_direction('gen_history_tpot_p95') == 'lower'
+    assert benchdiff.gate_direction('gen_history_clean_regressions') is None
+    assert benchdiff.gate_direction('gen_history_slow_regressions') is None
+    assert benchdiff.gate_direction('gen_history_burn_60s') is None
+    assert benchdiff.gate_direction('gen_history_overload_slo_missed') is None
+    assert benchdiff.gate_direction('gen_history_shed_requests') is None
+
+
+def test_emit_baseline_distills_newest_usable_record(tmp_path):
+    """--emit-baseline (ISSUE 18 satellite): r02 is the newest record
+    carrying envelope-source metrics, so its gen_value becomes the tok_s
+    baseline — through the SAME extraction code the runtime sentinel
+    loads, so gate and sentinel cannot disagree on what a record says."""
+    out = tmp_path / 'baseline.json'
+    proc = _run(
+        REPO / 'BENCH_r01.json', REPO / 'BENCH_r02.json',
+        '--emit-baseline', out,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc['schema'] == 'distllm-baseline-envelope/v1'
+    assert doc['source'] == 'r02'
+    assert doc['metrics']['tok_s'] == {
+        'value': 184.18, 'direction': 'higher', 'from_key': 'gen_value',
+    }
+    # Envelope-only invocations are legal at any record count: a single
+    # record emits and exits 0 (nothing to diff), and a pile with no
+    # usable metrics emits the EMPTY envelope (the sentinel's counted
+    # disarm mode), never a crash.
+    solo = _run(REPO / 'BENCH_r02.json', '--emit-baseline', out)
+    assert solo.returncode == 0, solo.stdout + solo.stderr
+    assert json.loads(out.read_text())['source'] == 'r02'
+    empty = _run(REPO / 'BENCH_r01.json', '--emit-baseline', out)
+    assert empty.returncode == 0, empty.stdout + empty.stderr
+    doc = json.loads(out.read_text())
+    assert doc['metrics'] == {} and doc['source'] == ''
+
+
 def test_gen_kvq_accuracy_regression_trips_gate(tmp_path):
     """A fallen greedy-match fraction alone (tok/s flat) trips the gate:
     the accuracy arm is enforceable, not decorative."""

@@ -135,7 +135,17 @@ def _start_chat_server(config: ChatAppConfig):
             time.sleep(0.1)
 
     def stop():
-        loop_holder['loop'].call_soon_threadsafe(loop_holder['loop'].stop)
+        loop = loop_holder['loop']
+
+        async def _shutdown():
+            # Run the app's on_cleanup hooks (history sampler/observer
+            # teardown) before stopping the loop — a bare loop.stop()
+            # would leak the sampler thread into the next test.
+            await loop_holder['runner'].cleanup()
+            loop.stop()
+
+        loop.call_soon_threadsafe(lambda: loop.create_task(_shutdown()))
+        thread.join(timeout=10)
 
     return f'http://127.0.0.1:{port}', stop
 
@@ -383,7 +393,9 @@ def test_chat_server_bundle_endpoint(chat_server_client, tmp_path, monkeypatch):
     body = requests.get(f'{base}/debug/bundle').json()
     assert body['bundle_dir'].startswith(str(tmp_path))
     paths = body['paths']
-    assert set(paths) >= {'flight', 'metrics', 'traces', 'meta', 'startup'}
+    assert set(paths) >= {
+        'flight', 'metrics', 'traces', 'meta', 'startup', 'history', 'slo'
+    }
     from pathlib import Path
 
     assert Path(paths['meta']).exists()
@@ -429,6 +441,55 @@ def test_chat_server_xprof_endpoint(chat_server_client, tmp_path, monkeypatch):
     assert body['state']['captures_total'] >= 1
     # Bad input -> 400, never a capture.
     assert requests.get(f'{base}/debug/xprof?seconds=x').status_code == 400
+
+
+def test_chat_server_history_endpoint(chat_server_client):
+    """GET /debug/history serves the distllm-history/v1 ring with the
+    background sampler running (DISTLLM_HISTORY_S default 1s); a bad
+    limit is a 400, never a traceback."""
+    import time
+
+    import requests
+
+    base = chat_server_client
+    requests.post(
+        f'{base}/v1/chat/completions',
+        json={'messages': [{'role': 'user', 'content': 'sample me'}]},
+    )
+    # The ring fills on the sampler's cadence, not the request path:
+    # counters need TWO folds before their first delta point exists, so
+    # wait out (at most) a few ticks.
+    deadline = time.time() + 15.0
+    while True:
+        body = requests.get(f'{base}/debug/history?limit=50').json()
+        if body['samples'] >= 2 or time.time() > deadline:
+            break
+        time.sleep(0.2)
+    assert body['schema'] == 'distllm-history/v1'
+    assert body['sampler_running'] is True
+    assert body['samples'] >= 2
+    assert body['capacity'] >= 2 and isinstance(body['series'], dict)
+    assert 'distllm_engine_generated_tokens_total' in body['series']
+    # The prefix filter narrows the series map to matching names.
+    narrowed = requests.get(f'{base}/debug/history?prefix=distllm_http').json()
+    assert narrowed['series']
+    assert all(k.startswith('distllm_http') for k in narrowed['series'])
+    assert requests.get(f'{base}/debug/history?limit=x').status_code == 400
+
+
+def test_chat_server_slo_endpoint(chat_server_client):
+    """GET /debug/slo: the burn-rate verdict document plus the sentinel
+    state (disarmed here — no DISTLLM_BASELINE in the test env)."""
+    import requests
+
+    base = chat_server_client
+    body = requests.get(f'{base}/debug/slo').json()
+    assert body['schema'] == 'distllm-slo/v1'
+    assert body['verdict'] in ('ok', 'warn', 'page')
+    assert set(body['burn_rates']) == {'60s', '300s', '600s', '3600s'}
+    sentinel = body['sentinel']
+    assert sentinel['schema'] == 'distllm-sentinel/v1'
+    assert sentinel['armed'] is False and sentinel['degraded'] == []
 
 
 # ------------------------------------------- resilience surface (ISSUE 15)

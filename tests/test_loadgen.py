@@ -234,3 +234,30 @@ def test_gen_load_stage_cpu_smoke(tmp_path):
 def test_gen_load_stage_env_skip(tmp_path):
     fragment = _run_stage(tmp_path, DISTLLM_BENCH_LOAD='0')
     assert fragment == {'gen_load_skipped': 'DISTLLM_BENCH_LOAD=0'}
+
+
+def test_loadgen_cli_reports_history_excerpt():
+    """scripts/loadgen.py (ISSUE 18 satellite): the CLI owns the process
+    history sampler for its run, and the JSON report line carries the
+    compact ``loadgen_history_*`` excerpt — the sampled tok/s series plus
+    the SLO burn-rate gauges — not just end-of-run aggregates."""
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS='cpu')
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / 'scripts' / 'loadgen.py'),
+            '--small', '--requests', '8', '--rate', '50', '--slo', '2.0',
+            '--history-interval', '0.2',
+        ],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    fragment = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert fragment['loadgen_requests'] == 8
+    assert fragment['loadgen_history_window_s'] == 60.0
+    assert fragment['loadgen_history_samples'] >= 2
+    assert fragment['loadgen_history_tok_s'] > 0
+    assert fragment['loadgen_history_tok_points']
+    assert set(fragment['loadgen_history_burn_rates']) == {
+        '60s', '300s', '600s', '3600s'
+    }

@@ -130,6 +130,21 @@ def test_histogram_quantile_edge_cases():
     )
     labeled.labels(kind='a').observe(1.5)
     assert 1.0 <= labeled.labels(kind='a').quantile(0.5) <= 2.0
+    # ALL mass in the +Inf bucket: every quantile clamps to the highest
+    # finite edge — the estimator cannot invent an upper bound the
+    # ladder never recorded.
+    inf_only = registry.histogram('test_q_inf_seconds', buckets=(1.0, 10.0))
+    inf_only.observe(50.0)
+    inf_only.observe(500.0)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert inf_only.quantile(q) == pytest.approx(10.0)
+    # Zero-delta interval (two identical cumulative snapshots — the
+    # history ring's idle tick): None, never a division.
+    from distllm_tpu.observability import quantile_from_cumulative
+
+    before = inf_only.cumulative_counts()
+    delta = [a - b for a, b in zip(inf_only.cumulative_counts(), before)]
+    assert quantile_from_cumulative(inf_only.buckets, delta, 0.5) is None
 
 
 def test_quantile_from_cumulative_delta_isolates_window():
