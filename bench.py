@@ -826,9 +826,10 @@ def _stage_gen_mixed() -> dict:
 
 def _stage_gen_spec() -> dict:
     """Prompt-lookup speculative decoding A/B (docs/speculative.md): the
-    SAME staggered greedy workload through three arms — the classic
+    SAME staggered workload through the greedy arms — the classic
     decode scan (``draft_k=0``), verify windows with drafting disabled
-    (``spec_draft_source='none'``), and full speculation.
+    (``spec_draft_source='none'``), and full speculation — plus a
+    sampled (temperature > 0) arm run twice for determinism evidence.
 
     The contract this stage checks and records:
 
@@ -846,12 +847,20 @@ def _stage_gen_spec() -> dict:
       speculative win in one number (every accepted token skipped its
       weight pass) — and tok/s for all arms, comparable to
       ``gen_tok_per_s``;
-    - verify windows actually ran (``spec_windows`` > 0).
+    - verify windows actually ran (``spec_windows`` > 0);
+    - the SAMPLED arm (``gen_spec_sampled_*``): the same workload at
+      temperature > 0 with explicit per-request seeds rides the verify
+      kernel through device-side rejection sampling
+      (docs/speculative.md "Sampled verification"). Run twice —
+      ``sampled_deterministic`` is the (seed, schedule) determinism
+      evidence, and ``sampled_accepted_tokens`` must be > 0 (the stage
+      records an error otherwise). ``sampled_accept_rate`` gates
+      higher-better in benchdiff.
 
     ``DISTLLM_BENCH_SPEC=0`` skips the stage (default on). The workload
-    is greedy (speculation is greedy-only) and deliberately repetitive —
-    shared prefixes plus prompts that repeat an n-gram motif, the
-    RAG-quote/MCQA-stem shape prompt lookup exploits.
+    is deliberately repetitive — shared prefixes plus prompts that
+    repeat an n-gram motif, the RAG-quote/MCQA-stem shape prompt lookup
+    exploits.
     """
     import jax
     import numpy as np
@@ -890,7 +899,12 @@ def _stage_gen_spec() -> dict:
         prompts.append(shared + tail if i % 3 == 0 else tail)
     budgets = [int(n) for n in rng.integers(out_lo, out_hi, size=n_prompts)]
 
-    def run_arm(k: int, source: str = 'prompt_lookup') -> dict:
+    def run_arm(
+        k: int,
+        source: str = 'prompt_lookup',
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+    ) -> dict:
         engine_cfg = EngineConfig(
             block_size=16,
             num_blocks=num_blocks,
@@ -912,9 +926,18 @@ def _stage_gen_spec() -> dict:
         )
         rids = [
             engine.add_request(
-                p, SamplingParams(temperature=0.0, max_tokens=n)
+                p,
+                SamplingParams(
+                    temperature=temperature,
+                    top_p=top_p,
+                    max_tokens=n,
+                    # Explicit per-request seed: the sampled arm's output
+                    # must be a pure function of (seed, schedule) so two
+                    # runs give determinism evidence, not a coin flip.
+                    seed=(1000 + i) if temperature > 0 else None,
+                ),
             )
-            for p, n in zip(prompts, budgets)
+            for i, (p, n) in enumerate(zip(prompts, budgets))
         ]
         start = time.perf_counter()
         seen: dict = {rid: [] for rid in rids}
@@ -942,9 +965,21 @@ def _stage_gen_spec() -> dict:
     classic = run_arm(0)
     null = run_arm(draft_k, source='none')
     on = run_arm(draft_k)
+    # Sampled arm (docs/speculative.md "Sampled verification"): the same
+    # workload at temperature > 0 with explicit per-request seeds, run
+    # TWICE for determinism evidence. Low temperature keeps the filtered
+    # target sharp, so prompt-lookup drafts (point-mass q) are accepted
+    # with high probability — the accepted > 0 contract is robust, not a
+    # fluke of a flat random-weights distribution.
+    sampled_temp, sampled_top_p = 0.15, 0.95
+    sampled = run_arm(draft_k, temperature=sampled_temp, top_p=sampled_top_p)
+    sampled_again = run_arm(
+        draft_k, temperature=sampled_temp, top_p=sampled_top_p
+    )
     warmup_secs = time.perf_counter() - warmup_start
     identical = on['tokens'] == null['tokens']
     matches_decode = on['tokens'] == classic['tokens']
+    sampled_deterministic = sampled['tokens'] == sampled_again['tokens']
     out = {
         f'{prefix}metric': 'speculative-decoding A/B',
         f'{prefix}tokens_identical': identical,
@@ -956,6 +991,13 @@ def _stage_gen_spec() -> dict:
         f'{prefix}windows': on['spec_windows'],
         f'{prefix}draft_tokens': on['draft_tokens'],
         f'{prefix}accepted_tokens': on['accepted_tokens'],
+        f'{prefix}sampled_tok_per_s': sampled['throughput_tok_s'],
+        f'{prefix}sampled_accept_rate': sampled['accept_rate'],
+        f'{prefix}sampled_accepted_tokens': sampled['accepted_tokens'],
+        f'{prefix}sampled_windows': sampled['spec_windows'],
+        f'{prefix}sampled_deterministic': sampled_deterministic,
+        f'{prefix}sampled_temperature': sampled_temp,
+        f'{prefix}sampled_top_p': sampled_top_p,
         f'{prefix}draft_k': draft_k,
         f'{prefix}elapsed_all_arms_s': round(warmup_secs, 1),
         f'{prefix}workload': _workload_fingerprint(
@@ -978,6 +1020,18 @@ def _stage_gen_spec() -> dict:
         out[f'{prefix}error'] = (
             'no speculative verify windows ran — draft_k routing is '
             'broken or the workload never decoded'
+        )
+    elif not sampled_deterministic:
+        out[f'{prefix}error'] = (
+            'sampled spec arm is nondeterministic across identical '
+            '(seed, schedule) runs — the counter-based PRNG contract '
+            '(docs/speculative.md "Sampled verification") is broken'
+        )
+    elif sampled['accepted_tokens'] == 0:
+        out[f'{prefix}error'] = (
+            'sampled spec arm accepted zero draft tokens — rejection '
+            'sampling is discarding every draft, so temperature > 0 '
+            'requests get no speculative win'
         )
     if not matches_decode:
         # Expected occasionally in bf16 (near-tie rounding across two

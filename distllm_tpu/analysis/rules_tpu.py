@@ -499,6 +499,18 @@ class HostSyncInHotPathRule(Rule):
             'write_prefill_kv',
             '_write_prefill_kv_quantized',
         ),
+        # Device sampling and sampled speculative verification
+        # (docs/speculative.md "Sampled verification"): these trace into
+        # every decode/mixed/spec dispatch, so any host sync here fires
+        # once per window — the packed verify result has exactly one
+        # audited fetch point in the engine, not inside these kernels.
+        'distllm_tpu/ops/sampling.py': (
+            'fold_row_keys',
+            'filter_logits',
+            'sample_tokens',
+            'sample_tokens_windowed',
+            'verify_spans',
+        ),
     }
 
     _SYNC_CALLS = frozenset({'asarray', 'array', 'device_get'})

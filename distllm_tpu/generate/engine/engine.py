@@ -66,7 +66,7 @@ from distllm_tpu.ops.paged_attention import (
     QuantizedKV,
     quantize_kv_rows,
 )
-from distllm_tpu.ops.sampling import sample_tokens
+from distllm_tpu.ops.sampling import fold_row_keys, sample_tokens
 from distllm_tpu.resilience.admission import (
     EngineLoadView,
     EngineOverloaded,
@@ -83,6 +83,13 @@ class SamplingParams:
     temperature: float = 0.5
     top_p: float = 1.0
     min_p: float = 0.0
+    # Per-request top-k over the served distribution (0 disables). Applied
+    # as a rank mask intersected with top-p/min-p (ops/sampling.py).
+    top_k: int = 0
+    # Per-request sampling seed; None derives a stable per-request seed
+    # from (EngineConfig.seed, request_id). Sampled output streams are
+    # deterministic per (seed, schedule) — docs/speculative.md.
+    seed: int | None = None
     max_tokens: int = 2000
     stop_token_ids: tuple[int, ...] = ()
 
@@ -90,6 +97,27 @@ class SamplingParams:
 # Sentinel returned by _dispatch_window when nothing can be dispatched
 # (every running slot's budget is covered by in-flight windows).
 _DRAIN = object()
+
+
+def _request_seed(
+    engine_seed: int, request_id: int, explicit: int | None
+) -> int:
+    """Resolve a request's uint32 sampling seed.
+
+    An explicit ``SamplingParams.seed`` wins (masked to uint32); otherwise
+    hash (engine seed, request id) so every request owns an independent
+    stream while the whole run stays reproducible from ``EngineConfig.seed``
+    and the admission order — the (seed, schedule) determinism contract
+    (docs/speculative.md "Sampled verification").
+    """
+    import hashlib
+
+    if explicit is not None:
+        return explicit & 0xFFFFFFFF
+    digest = hashlib.blake2s(
+        f'{engine_seed}:{request_id}'.encode(), digest_size=4
+    ).digest()
+    return int.from_bytes(digest, 'little')
 
 
 class RequestState(Enum):
@@ -141,10 +169,17 @@ class Request:
     prefill_done: int = 0
     # --- prompt-lookup speculative decoding (docs/speculative.md) ---
     # Per-request n-gram drafter (None = this row never drafts: draft_k
-    # is 0 or the request samples with temperature > 0). The drafter's
-    # index covers prompt+output history, which recompute preemption
-    # preserves, so it survives preemption untouched.
+    # is 0 or spec_draft_source is 'none'). Sampled rows draft too —
+    # device-side rejection sampling verifies their spans ("Sampled
+    # verification"). The drafter's index covers prompt+output history,
+    # which recompute preemption preserves, so it survives preemption
+    # untouched.
     drafter: 'object | None' = None
+    # Resolved per-request sampling seed (uint32 domain): the request's
+    # explicit SamplingParams.seed, else a stable hash of
+    # (EngineConfig.seed, request_id). Feeds the counter-based PRNG key
+    # derivation in ops/sampling.py.
+    sample_seed: int = 0
     # --- lifecycle timestamps (flight recorder, docs/observability.md) ---
     # monotonic seconds; 0.0 = not reached. t_admit/t_first_token keep
     # their FIRST value across recompute preemption: the client-visible
@@ -449,9 +484,11 @@ class EngineConfig(BaseConfig):
     # prefill), so every accepted draft token is a decode token that
     # skipped its weight pass. Greedy output with speculation on is
     # token-identical to speculation off (tested across the full engine
-    # identity matrix); rows with temperature > 0 fall back to span 1 —
-    # no drafting — because acceptance compares against the row's OWN
-    # sampled token, which is only deterministic under greedy.
+    # identity matrix); rows with temperature > 0 draft too and are
+    # verified device-side by exact rejection sampling against the
+    # filtered target distribution (docs/speculative.md "Sampled
+    # verification") — their sampled streams stay deterministic per
+    # (seed, schedule) via counter-based per-row PRNG keys.
     # 0 disables speculation entirely (the classic decode-scan windows).
     # Speculative windows process synchronously (the drafter needs the
     # host-fetched history), so pipeline_depth is effectively 1 while
@@ -628,7 +665,6 @@ class LLMEngine:
         self._requests: dict[int, Request] = {}
         self._next_id = itertools.count()
         self._finished: dict[int, Request] = {}
-        self._key = jax.random.PRNGKey(cfg.seed)
         # Serving-loop counters (windows, prefill dispatches, EOS-overshoot
         # waste); generate_ids folds them into ``telemetry`` per run so the
         # bench JSON carries the steady-state split (VERDICT r2 weak #6/#10).
@@ -886,11 +922,11 @@ class LLMEngine:
 
         def window_fn(
             params, ids, pos, ctx, k, v, bt, steps_left, temp, top_p, min_p,
-            key,
+            top_k, seeds,
         ):
             return mistral.decode_loop(
                 params, model, ids, pos, k, v, bt, ctx, steps_left,
-                temp, top_p, min_p, key, num_steps=num_steps,
+                temp, top_p, min_p, top_k, seeds, num_steps=num_steps,
                 attn_backend=attn_backend, max_table_positions=max_tables,
                 sampling_top_window=cfg.sampling_top_window,
                 layer_unroll=cfg.decode_layer_unroll,
@@ -904,13 +940,14 @@ class LLMEngine:
         # deployment never wants.
         def mixed_fn(
             params, ids, pos, ctx, k, v, bt, steps_left, temp, top_p,
-            min_p, key, c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp,
-            c_top_p, c_min_p,
+            min_p, top_k, seeds, c_ids, c_pos, c_bt, c_ctx, c_tails,
+            c_temp, c_top_p, c_min_p, c_top_k, c_seeds,
         ):
             return mistral.mixed_window(
                 params, model, ids, pos, k, v, bt, ctx, steps_left,
-                temp, top_p, min_p, key, c_ids, c_pos, c_bt, c_ctx,
-                c_tails, c_temp, c_top_p, c_min_p, num_steps=num_steps,
+                temp, top_p, min_p, top_k, seeds, c_ids, c_pos, c_bt,
+                c_ctx, c_tails, c_temp, c_top_p, c_min_p, c_top_k,
+                c_seeds, num_steps=num_steps,
                 attn_backend=attn_backend, max_table_positions=max_tables,
                 sampling_top_window=cfg.sampling_top_window,
                 layer_unroll=cfg.decode_layer_unroll,
@@ -929,11 +966,12 @@ class LLMEngine:
         # chunk tuple is pytree-static, so each compiles its own graph
         # and a pure-spec deployment never compiles the chunk shapes.
         def spec_fn(
-            params, ids, pos, ctx, k, v, bt, tails, temp, top_p, min_p, key,
+            params, ids, pos, ctx, k, v, bt, tails, temp, top_p, min_p,
+            top_k, seeds,
         ):
             return mistral.spec_window(
                 params, model, ids, pos, k, v, bt, ctx, tails,
-                temp, top_p, min_p, key,
+                temp, top_p, min_p, top_k, seeds,
                 max_table_positions=max_tables,
                 sampling_top_window=cfg.sampling_top_window,
                 attn_backend=attn_backend,
@@ -941,15 +979,15 @@ class LLMEngine:
 
         def spec_mixed_fn(
             params, ids, pos, ctx, k, v, bt, tails, temp, top_p, min_p,
-            key, c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp, c_top_p,
-            c_min_p,
+            top_k, seeds, c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp,
+            c_top_p, c_min_p, c_top_k, c_seeds,
         ):
             return mistral.spec_window(
                 params, model, ids, pos, k, v, bt, ctx, tails,
-                temp, top_p, min_p, key,
+                temp, top_p, min_p, top_k, seeds,
                 chunk=(
                     c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp, c_top_p,
-                    c_min_p,
+                    c_min_p, c_top_k, c_seeds,
                 ),
                 max_table_positions=max_tables,
                 sampling_top_window=cfg.sampling_top_window,
@@ -1037,8 +1075,10 @@ class LLMEngine:
             _write_prefill_all_layers, donate_argnums=(0, 1)
         )
         self._sample = jax.jit(
-            lambda lg, ky, t, tp, mp: sample_tokens(
-                lg, ky, t, tp, mp, top_window=cfg.sampling_top_window
+            lambda lg, t, tp, mp, tk, seeds, counters: sample_tokens(
+                lg, None, t, tp, mp,
+                top_window=cfg.sampling_top_window, top_k=tk,
+                row_keys=fold_row_keys(seeds, counters),
             )
         )
         # Tokens dispatched on device but not yet fetched, per request —
@@ -1133,12 +1173,13 @@ class LLMEngine:
             sds((b,), f32),
             sds((b,), f32),
             sds((b,), f32),
-            spec(jax.random.PRNGKey(0)),
+            sds((b,), i32),  # top_k
+            sds((b,), jnp.uint32),  # seeds
         )
         jitted = jax.jit(
             window_fn,
             donate_argnums=(4, 5),
-            in_shardings=(Format(Layout.AUTO),) + (Format(),) * 11,
+            in_shardings=(Format(Layout.AUTO),) + (Format(),) * 12,
         )
         compiled = jitted.lower(*shapes).compile()
         return compiled, compiled.input_formats[0][0]
@@ -1259,7 +1300,7 @@ class LLMEngine:
             self._mixed_window = jax.jit(
                 self._mixed_fn,
                 donate_argnums=(4, 5),
-                in_shardings=(pinned,) + (Format(),) * 19,
+                in_shardings=(pinned,) + (Format(),) * 22,
             )
         except Exception as exc:  # pragma: no cover - TPU-only path
             self.telemetry['mixed_layout_fallback'] = repr(exc)[:300]
@@ -1282,13 +1323,13 @@ class LLMEngine:
             self._spec_window = jax.jit(
                 self._spec_fn,
                 donate_argnums=(4, 5),
-                in_shardings=(pinned,) + (Format(),) * 11,
+                in_shardings=(pinned,) + (Format(),) * 12,
             )
             if self._spec_mixed_window is not None:
                 self._spec_mixed_window = jax.jit(
                     self._spec_mixed_fn,
                     donate_argnums=(4, 5),
-                    in_shardings=(pinned,) + (Format(),) * 19,
+                    in_shardings=(pinned,) + (Format(),) * 22,
                 )
         except Exception as exc:  # pragma: no cover - TPU-only path
             self.telemetry['spec_layout_fallback'] = repr(exc)[:300]
@@ -1314,7 +1355,6 @@ class LLMEngine:
         MFU gauges.
         """
         watch = self._compile_watcher
-        saved_key = self._key  # sampling stream must not observe warmup
         # Quantized pools compile their own executables for every phase
         # that touches KV (the int8 scatter/dequant graphs are different
         # programs): tag the shape labels so the compile ledger
@@ -1466,7 +1506,8 @@ class LLMEngine:
                 self._put(np.zeros((bsz,), np.float32)),
                 self._put(np.ones((bsz,), np.float32)),
                 self._put(np.zeros((bsz,), np.float32)),
-                jax.random.PRNGKey(0),
+                self._put(np.zeros((bsz,), np.int32)),
+                self._put(np.zeros((bsz,), np.uint32)),
             )
             self._merge_ids(
                 self._put(np.zeros((bsz,), np.int32)),
@@ -1519,7 +1560,8 @@ class LLMEngine:
                             self._put(np.zeros((bsz,), np.float32)),
                             self._put(np.ones((bsz,), np.float32)),
                             self._put(np.zeros((bsz,), np.float32)),
-                            jax.random.PRNGKey(0),
+                            self._put(np.zeros((bsz,), np.int32)),
+                            self._put(np.zeros((bsz,), np.uint32)),
                             self._put(np.zeros((cb, bucket), np.int32)),
                             self._put(np.zeros((cb, bucket), np.int32)),
                             self._put(
@@ -1532,6 +1574,8 @@ class LLMEngine:
                             self._put(np.zeros((cb,), np.float32)),
                             self._put(np.ones((cb,), np.float32)),
                             self._put(np.zeros((cb,), np.float32)),
+                            self._put(np.zeros((cb,), np.int32)),
+                            self._put(np.zeros((cb,), np.uint32)),
                         )
                     )
                     np.asarray(mixed_tokens)
@@ -1559,7 +1603,8 @@ class LLMEngine:
                     self._put(np.zeros((bsz,), np.float32)),
                     self._put(np.ones((bsz,), np.float32)),
                     self._put(np.zeros((bsz,), np.float32)),
-                    jax.random.PRNGKey(0),
+                    self._put(np.zeros((bsz,), np.int32)),
+                    self._put(np.zeros((bsz,), np.uint32)),
                 )
                 np.asarray(spec_tokens)
         if self._spec_mixed_window is not None:
@@ -1594,7 +1639,8 @@ class LLMEngine:
                             self._put(np.zeros((bsz,), np.float32)),
                             self._put(np.ones((bsz,), np.float32)),
                             self._put(np.zeros((bsz,), np.float32)),
-                            jax.random.PRNGKey(0),
+                            self._put(np.zeros((bsz,), np.int32)),
+                            self._put(np.zeros((bsz,), np.uint32)),
                             self._put(np.zeros((cb, bucket), np.int32)),
                             self._put(np.zeros((cb, bucket), np.int32)),
                             self._put(
@@ -1607,13 +1653,14 @@ class LLMEngine:
                             self._put(np.zeros((cb,), np.float32)),
                             self._put(np.ones((cb,), np.float32)),
                             self._put(np.zeros((cb,), np.float32)),
+                            self._put(np.zeros((cb,), np.int32)),
+                            self._put(np.zeros((cb,), np.uint32)),
                         )
                     )
                     np.asarray(spec_tokens)
         # On this backend block_until_ready does not synchronize; a tiny
         # host fetch is the only reliable completion barrier.
         np.asarray(tokens)
-        self._key = saved_key
         # Price what XLA actually compiled, now that every serving
         # executable is warm (measured MFU gauges + calibration ratios,
         # docs/observability.md "Measured vs analytic MFU").
@@ -1675,7 +1722,9 @@ class LLMEngine:
         def of(*shape):
             return self._put(np.ones(shape, np.float32))
 
-        key = jax.random.PRNGKey(0)
+        def zu(*shape):
+            return self._put(np.zeros(shape, np.uint32))
+
         bt = zi(bsz, self.max_blocks_per_seq)
         targets: list[tuple[str, object, tuple]] = []
         bucket = self.prefill_buckets[-1]
@@ -1689,7 +1738,7 @@ class LLMEngine:
             'decode',
             self._decode_window,
             (self.params, zi(bsz), zi(bsz), oi(bsz), self.kv.k, self.kv.v,
-             bt, zi(bsz), zf(bsz), of(bsz), zf(bsz), key),
+             bt, zi(bsz), zf(bsz), of(bsz), zf(bsz), zi(bsz), zu(bsz)),
         ))
         if self._spec_window is not None:
             span = 1 + cfg.draft_k
@@ -1698,7 +1747,7 @@ class LLMEngine:
                 self._spec_window,
                 (self.params, zi(bsz, span), zi(bsz, span), oi(bsz),
                  self.kv.k, self.kv.v, bt, zi(bsz), zf(bsz), of(bsz),
-                 zf(bsz), key),
+                 zf(bsz), zi(bsz), zu(bsz)),
             ))
         if self._mixed_window is not None and not cfg.draft_k:
             span_bucket = pick_bucket(
@@ -1711,9 +1760,11 @@ class LLMEngine:
                     'mixed',
                     self._mixed_window,
                     (self.params, zi(bsz), zi(bsz), oi(bsz), self.kv.k,
-                     self.kv.v, bt, zi(bsz), zf(bsz), of(bsz), zf(bsz), key,
+                     self.kv.v, bt, zi(bsz), zf(bsz), of(bsz), zf(bsz),
+                     zi(bsz), zu(bsz),
                      zi(cb, mb), zi(cb, mb), zi(cb, self.max_blocks_per_seq),
-                     oi(cb), zi(cb), zf(cb), of(cb), zf(cb)),
+                     oi(cb), zi(cb), zf(cb), of(cb), zf(cb), zi(cb),
+                     zu(cb)),
                 ))
         for kind, fn, args in targets:
             try:
@@ -1777,15 +1828,18 @@ class LLMEngine:
             # add happens inside one; None for offline/batch callers.
             trace_id=current_request_id(),
         )
+        request.sample_seed = _request_seed(
+            self.config.seed, request.request_id,
+            request.params.seed,
+        )
         if (
             self.config.draft_k
             and self.config.spec_draft_source == 'prompt_lookup'
-            and request.params.temperature <= 0
         ):
-            # Prompt-lookup drafting is greedy-only: acceptance compares
-            # drafts against the row's own sampled tokens, deterministic
-            # only at temperature 0. Stochastic rows fall back to span 1
-            # (plain single-step verify — no drafting, no wrong trade).
+            # Greedy rows verify by argmax comparison; temperature > 0
+            # rows verify by device-side rejection sampling against the
+            # filtered target (docs/speculative.md "Sampled
+            # verification") — both draft from the same n-gram lookup.
             from distllm_tpu.generate.engine.spec import PromptLookupDrafter
 
             request.drafter = PromptLookupDrafter(self.config.spec_ngram)
@@ -2556,12 +2610,16 @@ class LLMEngine:
         c_temp = np.zeros((cb,), np.float32)
         c_top_p = np.ones((cb,), np.float32)
         c_min_p = np.zeros((cb,), np.float32)
+        c_top_k = np.zeros((cb,), np.int32)
+        c_seeds = np.zeros((cb,), np.uint32)
         for i, (request, _, _) in enumerate(chunk_plan):
             c_temp[i] = request.params.temperature
             c_top_p[i] = request.params.top_p
             c_min_p[i] = request.params.min_p
+            c_top_k[i] = request.params.top_k
+            c_seeds[i] = request.sample_seed
         return [ids, positions, block_rows, context_lens, tail_lens,
-                c_temp, c_top_p, c_min_p]
+                c_temp, c_top_p, c_min_p, c_top_k, c_seeds]
 
     # -------------------------------------------------------------- prefill
     def _mark_prefill_retry(self, requests: list[Request]) -> None:
@@ -3320,6 +3378,8 @@ class LLMEngine:
         temperature = np.zeros((b,), np.float32)
         top_p = np.ones((b,), np.float32)
         min_p = np.zeros((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+        seeds = np.zeros((b,), np.uint32)
         override_mask = np.zeros((b,), bool)
         plan: list[tuple[int, int, int]] = []
         any_steps = False
@@ -3335,6 +3395,8 @@ class LLMEngine:
             temperature[slot] = request.params.temperature
             top_p[slot] = request.params.top_p
             min_p[slot] = request.params.min_p
+            top_k[slot] = request.params.top_k
+            seeds[slot] = request.sample_seed
             if unacked == 0:
                 ids[slot] = (
                     request.output_ids[-1]
@@ -3349,7 +3411,7 @@ class LLMEngine:
 
         host_arrays = [
             ids, override_mask, positions, context_lens, block_tables,
-            steps_left, temperature, top_p, min_p,
+            steps_left, temperature, top_p, min_p, top_k, seeds,
         ]
         if chunk_plan:
             host_arrays.extend(self._build_chunk_arrays(chunk_plan))
@@ -3366,10 +3428,11 @@ class LLMEngine:
             temperature_dev,
             top_p_dev,
             min_p_dev,
-        ) = devs[:9]
+            top_k_dev,
+            seeds_dev,
+        ) = devs[:11]
         if carried_ids is not None:
             ids_dev = self._merge_ids(carried_ids, override_dev, ids_dev)
-        self._key, key = jax.random.split(self._key)
         chunk_tokens = None
         chunk_entries: list[tuple[int, int, int, int, bool]] = []
         if chunk_plan:
@@ -3392,8 +3455,9 @@ class LLMEngine:
                     temperature_dev,
                     top_p_dev,
                     min_p_dev,
-                    key,
-                    *devs[9:],
+                    top_k_dev,
+                    seeds_dev,
+                    *devs[11:],
                 )
             ridden = 0
             for i, (request, start, ntok) in enumerate(chunk_plan):
@@ -3423,7 +3487,8 @@ class LLMEngine:
                     temperature_dev,
                     top_p_dev,
                     min_p_dev,
-                    key,
+                    top_k_dev,
+                    seeds_dev,
                 )
         for _, rid, steps in plan:
             if steps:
@@ -3522,6 +3587,8 @@ class LLMEngine:
         temperature = np.zeros((b,), np.float32)
         top_p = np.ones((b,), np.float32)
         min_p = np.zeros((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+        seeds = np.zeros((b,), np.uint32)
         plan: list[tuple[int, int, list[int]]] = []
         for slot, rid in self.sched.running():
             drafts = drafts_by_rid.get(rid)
@@ -3543,6 +3610,8 @@ class LLMEngine:
             temperature[slot] = request.params.temperature
             top_p[slot] = request.params.top_p
             min_p[slot] = request.params.min_p
+            top_k[slot] = request.params.top_k
+            seeds[slot] = request.sample_seed
             plan.append((slot, rid, drafts))
         if not plan and not chunk_plan:
             return _DRAIN
@@ -3552,14 +3621,13 @@ class LLMEngine:
         )
         host_arrays = [
             ids, positions, block_rows, context_lens, tail_lens,
-            temperature, top_p, min_p,
+            temperature, top_p, min_p, top_k, seeds,
         ]
         if chunk_plan:
             host_arrays.extend(self._build_chunk_arrays(chunk_plan))
         t_host = time.monotonic()
         devs = self._put_many(*host_arrays)
         t_put = time.monotonic()
-        self._key, key = jax.random.split(self._key)
         chunk_tokens = None
         chunk_entries: list[tuple[int, int, int, int, bool]] = []
         if chunk_plan:
@@ -3577,8 +3645,9 @@ class LLMEngine:
                         devs[5],
                         devs[6],
                         devs[7],
-                        key,
-                        *devs[8:],
+                        devs[8],  # top_k
+                        devs[9],  # seeds
+                        *devs[10:],
                     )
                 )
             ridden = 0
@@ -3611,7 +3680,8 @@ class LLMEngine:
                     devs[5],
                     devs[6],
                     devs[7],
-                    key,
+                    devs[8],
+                    devs[9],
                 )
         ndrafted = sum(len(d) for _, _, d in plan)
         self._stats['spec_windows'] += 1
@@ -3632,42 +3702,53 @@ class LLMEngine:
 
     def _process_spec_window(self, window: dict) -> list[tuple[int, int]]:
         """Fetch one verify window's tokens and run the greedy acceptance
-        rule (the only host sync of the speculative path).
+        decisions already made device-side (the only host sync of the
+        speculative path).
 
-        Per row, token ``i`` of the span is what sequential decode would
-        emit after consuming the span's first ``i+1`` tokens. Token 0 is
-        always emitted (it follows the last REAL token); draft ``i`` is
-        accepted — and token ``i+1`` emitted — only while it equals the
-        previously emitted token, so the output stream is exactly the
-        sequential greedy stream (each accepted draft skipped one weight
-        pass). EOS / max_tokens inside the accepted prefix finish the
-        request mid-span and the remaining verified tokens are discarded.
-        Rejected suffixes roll back: ``sched.trim`` returns the unused
-        per-row headroom so scheduler state matches a never-drafted run
-        (the rejected K/V needs no rollback — it sits at positions every
-        later dispatch overwrites before attending or masks out).
+        The packed fetch is ``[B, S+1]``: per-position output tokens plus
+        the accepted-draft count computed by ``verify_spans`` inside the
+        dispatch — greedy argmax comparison for temperature-0 rows,
+        exact rejection sampling for sampled rows (docs/speculative.md
+        "Sampled verification"). Token 0 is always emitted (it follows
+        the last REAL token); tokens 1..accept_len are the accepted
+        drafts' successors, and token accept_len is the correction /
+        bonus, so the output stream is exactly the sequential stream
+        (each accepted draft skipped one weight pass). EOS / max_tokens
+        inside the accepted prefix finish the request mid-span and the
+        remaining verified tokens are discarded. Rejected suffixes roll
+        back: ``sched.trim`` returns the unused per-row headroom so
+        scheduler state matches a never-drafted run (the rejected K/V
+        needs no rollback — it sits at positions every later dispatch
+        overwrites before attending or masks out).
         """
         t_fetch = time.monotonic()
         with self._annotate('fetch'):
-            # distlint: disable=host-sync-in-hot-path -- the spec window's ONE designed fetch point: acceptance needs all 1+draft_k verified tokens on host, and spec windows process synchronously (depth 1)
-            tokens = np.asarray(window['tokens'])  # [B, S]
+            # distlint: disable=host-sync-in-hot-path -- the spec window's ONE designed fetch point: emission needs the verified tokens + accept length on host, and spec windows process synchronously (depth 1)
+            tokens = np.asarray(window['tokens'])  # [B, S+1] packed
         fetch_s = time.monotonic() - t_fetch
         emitted: list[tuple[int, int]] = []
         drafted = accepted = rows = 0
+        sampled_rows = resampled = 0
         for slot, rid, drafts in window['plan']:
             request = self._requests.get(rid)
             if request is None or request.state is not RequestState.RUNNING:
                 continue  # finished/preempted during an abnormal drain
             rows += 1
             drafted += len(drafts)
+            sampled = request.params.temperature > 0
+            if sampled and drafts:
+                sampled_rows += 1
+            n_acc = min(int(tokens[slot, -1]), len(drafts))
+            if sampled and drafts and n_acc < len(drafts):
+                # A sampled row that stopped short burned one residual
+                # resample (the correction token).
+                resampled += 1
             token = int(tokens[slot, 0])
             self._emit_token(request, token)
             emitted.append((rid, token))
-            for i, draft in enumerate(drafts):
+            for i in range(n_acc):
                 if rid not in self._requests:
                     break  # finished (EOS / max_tokens): discard the rest
-                if draft != token:
-                    break  # first mismatch: the correction is already out
                 accepted += 1
                 token = int(tokens[slot, i + 1])
                 self._emit_token(request, token)
@@ -3675,12 +3756,23 @@ class LLMEngine:
             if rid in self._requests and request.state is RequestState.RUNNING:
                 self.sched.trim(rid)
         self._stats['spec_accepted_tokens'] += accepted
+        self._stats['spec_sampled_rows'] += sampled_rows
+        self._stats['spec_resampled_tokens'] += resampled
         if accepted:
             _metrics.SPEC_ACCEPTED_TOKENS.inc(accepted)
         if drafted:
             _metrics.SPEC_ACCEPT_RATE.observe(accepted / drafted)
+        if sampled_rows:
+            _metrics.SPEC_SAMPLED_ROWS.inc(sampled_rows)
+        if resampled:
+            _metrics.SPEC_RESAMPLED_TOKENS.inc(resampled)
         chunk_entries = window.get('chunk_plan') or []
-        extra = {'draft_tokens': drafted, 'accepted_tokens': accepted}
+        extra = {
+            'draft_tokens': drafted,
+            'accepted_tokens': accepted,
+            'sampled_rows': sampled_rows,
+            'resampled_tokens': resampled,
+        }
         if chunk_entries:
             extra['prefill_tokens'] = sum(
                 n for *_, n, _ in chunk_entries
@@ -4088,15 +4180,27 @@ class LLMEngine:
         temperature = np.zeros((b,), np.float32)
         top_p = np.ones((b,), np.float32)
         min_p = np.zeros((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+        seeds = np.zeros((b,), np.uint32)
+        counters = np.zeros((b,), np.int32)
         for i, request in enumerate(slots):
             if request is None:
                 continue
             temperature[i] = request.params.temperature
             top_p[i] = request.params.top_p
             min_p[i] = request.params.min_p
-        self._key, key = jax.random.split(self._key)
-        t_dev, tp_dev, mp_dev = self._put_many(temperature, top_p, min_p)
-        return self._sample(logits, key, t_dev, tp_dev, mp_dev)
+            top_k[i] = request.params.top_k
+            seeds[i] = request.sample_seed
+            # The prompt occupies absolute indices 0..num_tokens-1, so
+            # the first generated token's index — its PRNG counter — is
+            # num_tokens (matches the decode scan's pos + 1 convention).
+            counters[i] = request.num_tokens
+        t_dev, tp_dev, mp_dev, tk_dev, sd_dev, ct_dev = self._put_many(
+            temperature, top_p, min_p, top_k, seeds, counters
+        )
+        return self._sample(
+            logits, t_dev, tp_dev, mp_dev, tk_dev, sd_dev, ct_dev
+        )
 
     def _emit_token(self, request: Request, token: int) -> None:
         # Note: the emitted token is NOT yet written to the KV cache; it is

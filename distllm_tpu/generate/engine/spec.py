@@ -21,6 +21,17 @@ Drafts are PROPOSALS only: the engine verifies all of them in one ragged
 dispatch and keeps the longest matching prefix, so a bad draft costs one
 span slot, never a wrong token (``LLMEngine._process_spec_window``).
 
+Draft-distribution convention (docs/speculative.md "Sampled
+verification"): this drafter proposes tokens without probabilities, so
+the rejection-sampling verifier treats q as a POINT MASS on the proposed
+token — the acceptance probability for draft d degenerates to
+min(1, p(d)/1) = p̃(d), the filtered target probability of d itself, and
+the rejection residual (p − q)+ normalizes to p with d masked out. A
+future model-based drafter supplying real q distributions plugs into the
+same ``spec_draft_source`` seam; the verifier math in
+``distllm_tpu.ops.sampling.verify_spans`` already phrases acceptance in
+p/q terms, so only the q inputs change.
+
 Cost note: the first ``draft`` call after admission indexes the whole
 prompt — one sha256 of a tiny n-gram string per position, sub-µs each,
 ~30 ms one-time at 32k context — and stays incremental afterwards (vLLM's

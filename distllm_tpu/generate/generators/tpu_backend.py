@@ -105,8 +105,10 @@ class TpuGeneratorConfig(BaseConfig):
         description='Prompt-lookup speculative decoding: draft up to '
         'this many tokens per row from the row\'s own history and '
         'verify them in one ragged dispatch — every accepted token '
-        'skipped a weight pass (docs/speculative.md). Greedy-only '
-        '(temperature must be 0); 0 disables.',
+        'skipped a weight pass (docs/speculative.md). Greedy rows '
+        'verify by argmax comparison; temperature > 0 rows verify by '
+        'device-side rejection sampling ("Sampled verification"); '
+        '0 disables.',
     )
     spec_ngram: int | None = Field(
         default=None,
@@ -158,24 +160,6 @@ class TpuGeneratorConfig(BaseConfig):
             raise ValueError(
                 f'attn_backend must be one of {ATTN_BACKENDS}, '
                 f'got {self.attn_backend!r}'
-            )
-        return self
-
-    @model_validator(mode='after')
-    def _spec_requires_greedy(self) -> 'TpuGeneratorConfig':
-        if self.draft_k and self.temperature > 0:
-            # The acceptance rule compares drafts against the row's OWN
-            # sampled token, which is deterministic only under greedy
-            # decoding; with temperature > 0 the engine would fall back
-            # to draft_k=0 per row anyway, so a config asking for both is
-            # asking for speculation it can never get — fail loudly
-            # instead of serving a silently inert knob
-            # (docs/speculative.md).
-            raise ValueError(
-                'draft_k > 0 requires temperature == 0: speculative '
-                'verification is greedy-only (the engine would disable '
-                'drafting per-row for stochastic sampling, making the '
-                'knob inert) — see docs/speculative.md'
             )
         return self
 

@@ -56,6 +56,7 @@ class Arrival:
     max_tokens: int
     session: int | None  # warm session id, None = cold (unique prompt)
     temperature: float = 0.0
+    top_p: float = 1.0
 
 
 @dataclass
@@ -80,6 +81,11 @@ class LoadgenConfig:
     output_tokens: tuple[int, int] = (4, 24)
     vocab_size: int = 2048
     temperature: float = 0.0  # greedy: deterministic across A/B arms
+    # Nucleus filtering for sampled (temperature > 0) workloads; 1.0
+    # disables. Sampled streams stay deterministic per (seed, schedule)
+    # via the engine's counter-based per-request PRNG keys
+    # (docs/speculative.md "Sampled verification").
+    top_p: float = 1.0
     # Engine paged-pool override (blocks), consumed by the engine-building
     # callers (scripts/loadgen.py CLI, the bench gen_tier stage) rather
     # than by build_workload: sizing the pool BELOW the workload's warm
@@ -129,6 +135,7 @@ def build_workload(cfg: LoadgenConfig) -> list[Arrival]:
                 max_tokens=int(rng.integers(out_lo, out_hi + 1)),
                 session=session,
                 temperature=cfg.temperature,
+                top_p=cfg.top_p,
             )
         )
     return workload
@@ -267,6 +274,7 @@ def run_loadgen(
                     list(arrival.prompt_ids),
                     SamplingParams(
                         temperature=arrival.temperature,
+                        top_p=arrival.top_p,
                         max_tokens=arrival.max_tokens,
                     ),
                 )

@@ -775,7 +775,8 @@ def decode_loop(  # distlint: traced
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B]
     min_p: jnp.ndarray,  # [B]
-    key: jax.Array,
+    top_k: jnp.ndarray,  # [B] int32 (0 disables)
+    seeds: jnp.ndarray,  # [B] uint32 per-request sampling seeds
     num_steps: int,
     attn_backend: str = 'xla',
     max_table_positions: int | None = None,
@@ -800,7 +801,7 @@ def decode_loop(  # distlint: traced
 
     Returns ``(tokens [num_steps, B] int32, k_cache, v_cache, last_ids)``.
     """
-    from distllm_tpu.ops.sampling import sample_tokens
+    from distllm_tpu.ops.sampling import fold_row_keys, sample_tokens
 
     # RoPE tables bounded by what positions can actually reach: the block
     # table row covers max_table_positions tokens (engine max_model_len) —
@@ -808,7 +809,7 @@ def decode_loop(  # distlint: traced
     table_len = max_table_positions or cfg.max_position_embeddings
     cos, sin = _rope_tables(cfg, table_len)
 
-    def body(carry, step_key):
+    def body(carry, _):
         ids, pos, ctx, k_cache, v_cache, live_steps = carry
         live = live_steps > 0
         # Out-of-budget slots write to the trash block (row of zeros) and
@@ -818,16 +819,19 @@ def decode_loop(  # distlint: traced
             params, cfg, ids, pos, k_cache, v_cache, bt_eff, ctx,
             cos, sin, attn_backend, layer_unroll,
         )
+        # Counter-derived per-row keys: the token produced this step sits
+        # at absolute index pos + 1 (frozen slots repeat a key, but their
+        # tokens are discarded host-side anyway).
+        row_keys = fold_row_keys(seeds, pos + 1)
         token = sample_tokens(
-            logits_, step_key, temperature, top_p, min_p,
-            top_window=sampling_top_window,
+            logits_, None, temperature, top_p, min_p,
+            top_window=sampling_top_window, top_k=top_k, row_keys=row_keys,
         )
         ids = jnp.where(live, token, ids)
         pos = jnp.where(live, pos + 1, pos)
         ctx = jnp.where(live, ctx + 1, ctx)
         return (ids, pos, ctx, k_cache, v_cache, live_steps - 1), token
 
-    keys = jax.random.split(key, num_steps)
     (ids, _, _, k_cache, v_cache, _), tokens = jax.lax.scan(
         body,
         (
@@ -838,7 +842,8 @@ def decode_loop(  # distlint: traced
             v_cache,
             steps_left.astype(jnp.int32),
         ),
-        keys,
+        None,
+        length=num_steps,
     )
     return tokens, k_cache, v_cache, ids
 
@@ -857,7 +862,8 @@ def mixed_window(  # distlint: traced
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B]
     min_p: jnp.ndarray,  # [B]
-    key: jax.Array,
+    top_k: jnp.ndarray,  # [B] int32 (0 disables)
+    seeds: jnp.ndarray,  # [B] uint32 per-request sampling seeds
     # --- ragged prefill-chunk operands (prefill_paged shapes) ---
     chunk_ids: jnp.ndarray,  # [C, S] uncached tail-span tokens (padded)
     chunk_positions: jnp.ndarray,  # [C, S] absolute positions
@@ -867,6 +873,8 @@ def mixed_window(  # distlint: traced
     chunk_temperature: jnp.ndarray,  # [C]
     chunk_top_p: jnp.ndarray,  # [C]
     chunk_min_p: jnp.ndarray,  # [C]
+    chunk_top_k: jnp.ndarray,  # [C] int32 (0 disables)
+    chunk_seeds: jnp.ndarray,  # [C] uint32 per-request sampling seeds
     num_steps: int,
     attn_backend: str = 'xla',
     max_table_positions: int | None = None,
@@ -892,27 +900,28 @@ def mixed_window(  # distlint: traced
     Returns ``(tokens [num_steps, B], k_cache, v_cache, last_ids,
     chunk_tokens [C])`` where ``chunk_tokens`` samples each chunk row's
     last valid position (meaningful only for rows that finish their tail
-    this window; the engine discards the rest). The key splits once into
-    (chunk, decode) streams, so stochastic draws differ from the pure
-    separate-prefill path — token identity versus that path is exact for
-    greedy (temperature 0) sampling, which is what the engine's identity
-    tests and the bench A/B pin.
+    this window; the engine discards the rest). Every draw — chunk and
+    decode alike — uses the counter-derived per-row key for the token
+    being produced (``fold_row_keys``), so stochastic tokens are identical
+    to the pure separate-prefill path too, not just greedy ones.
     """
-    from distllm_tpu.ops.sampling import sample_tokens
+    from distllm_tpu.ops.sampling import fold_row_keys, sample_tokens
 
-    chunk_key, decode_key = jax.random.split(key)
     chunk_logits, k_cache, v_cache = prefill_paged(
         params, cfg, chunk_ids, chunk_positions, k_cache, v_cache,
         chunk_block_tables, chunk_context_lens, chunk_tail_lens,
         max_table_positions=max_table_positions, attn_backend=attn_backend,
     )
+    # A chunk row's sampled token is its prompt's first generated token:
+    # absolute index == chunk_context_lens (tokens 0..ctx-1 are prompt).
     chunk_tokens = sample_tokens(
-        chunk_logits, chunk_key, chunk_temperature, chunk_top_p,
-        chunk_min_p, top_window=sampling_top_window,
+        chunk_logits, None, chunk_temperature, chunk_top_p,
+        chunk_min_p, top_window=sampling_top_window, top_k=chunk_top_k,
+        row_keys=fold_row_keys(chunk_seeds, chunk_context_lens),
     )
     tokens, k_cache, v_cache, last_ids = decode_loop(
         params, cfg, input_ids, positions, k_cache, v_cache, block_tables,
-        context_lens, steps_left, temperature, top_p, min_p, decode_key,
+        context_lens, steps_left, temperature, top_p, min_p, top_k, seeds,
         num_steps=num_steps, attn_backend=attn_backend,
         max_table_positions=max_table_positions,
         sampling_top_window=sampling_top_window, layer_unroll=layer_unroll,
@@ -934,54 +943,68 @@ def spec_window(  # distlint: traced
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B]
     min_p: jnp.ndarray,  # [B]
-    key: jax.Array,
+    top_k: jnp.ndarray,  # [B] int32 (0 disables)
+    seeds: jnp.ndarray,  # [B] uint32 per-request sampling seeds
     # --- optional prefill-chunk operands (mixed batching composition) ---
-    chunk: tuple | None = None,  # (ids, pos, bt, ctx, tails, temp, tp, mp)
+    chunk: tuple | None = None,  # (ids, pos, bt, ctx, tails, temp, tp,
+    #                               mp, tk, seeds)
     max_table_positions: int | None = None,
     sampling_top_window: int = 0,
     attn_backend: str = 'xla',
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray | None]:
-    """One SPECULATIVE verify window: score every row's draft span in a
-    single ragged dispatch (docs/speculative.md).
+    """One SPECULATIVE verify window: score every row's draft span and run
+    the accept/resample rule in a single ragged dispatch
+    (docs/speculative.md "Sampled verification").
 
     Each row carries ``[last_emitted_token, d_1, .., d_k]`` at absolute
     positions ``num_tokens-1 ..`` — the exact per-row-query-span shape
     :func:`prefill_paged` already dispatches (write-then-attend through
     ``ragged_paged_attention_xla``), so one weight pass scores all
-    ``1+draft_k`` positions. Position ``i``'s sampled token is what
-    sequential decode would emit after consuming the span's first ``i+1``
-    tokens; the engine's host-side acceptance rule keeps the longest
-    prefix where draft ``d_{i+1}`` equals token ``i``. Rejected suffixes
-    need no device-side rollback: their K/V writes sit at positions at or
-    beyond the row's post-acceptance ``num_tokens``, which every later
-    dispatch either overwrites before attending (write-then-attend) or
-    masks out (``kv_pos <= q_pos``).
+    ``1+draft_k`` positions. Verification happens device-side in
+    :func:`distllm_tpu.ops.sampling.verify_spans`: greedy rows keep the
+    longest prefix where draft ``d_{i+1}`` equals the argmax at position
+    ``i`` (bit-identical to the pre-sampled-verification host loop);
+    temperature > 0 rows run exact rejection sampling against the filtered
+    target (accept w.p. min(1, p̃/q); resample the positive residual on
+    the first rejection). Acceptance decisions never bounce through the
+    host mid-dispatch — only the packed tokens + accept length travel back
+    at the engine's one audited fetch point. Rejected suffixes need no
+    device-side rollback: their K/V writes sit at positions at or beyond
+    the row's post-acceptance ``num_tokens``, which every later dispatch
+    either overwrites before attending (write-then-attend) or masks out
+    (``kv_pos <= q_pos``).
 
     ``chunk`` (pytree-static; ``None`` compiles a chunk-free graph)
     carries mixed-batching prefill-chunk rows exactly as
     :func:`mixed_window` does — same :func:`prefill_paged` pass, so the
     chunk half stays bit-identical to its standalone dispatch.
 
-    Returns ``(span_tokens [B, S] int32, k_cache, v_cache, chunk_tokens
-    [C] | None)``. Greedy rows (temperature 0) ignore the key, which is
-    what the speculation-on/off token-identity guarantee rests on;
-    stochastic rows ride with span length 1 (the engine never drafts for
-    them) and draw from a different key-split order than the decode scan.
+    Returns ``(packed [B, S+1] int32, k_cache, v_cache, chunk_tokens
+    [C] | None)`` where ``packed[:, :S]`` are the per-position output
+    tokens and ``packed[:, S]`` is the accepted-draft count (see
+    :func:`verify_spans`). All draws use counter-derived per-row keys, so
+    a span-1 verify of a sampled row emits the exact token the decode
+    scan would have.
     """
-    from distllm_tpu.ops.sampling import sample_tokens
+    from distllm_tpu.ops.sampling import (
+        fold_row_keys,
+        sample_tokens,
+        verify_spans,
+    )
 
     chunk_tokens = None
     if chunk is not None:
-        c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp, c_top_p, c_min_p = chunk
-        chunk_key, key = jax.random.split(key)
+        (c_ids, c_pos, c_bt, c_ctx, c_tails, c_temp, c_top_p, c_min_p,
+         c_top_k, c_seeds) = chunk
         chunk_logits, k_cache, v_cache = prefill_paged(
             params, cfg, c_ids, c_pos, k_cache, v_cache, c_bt, c_ctx,
             c_tails, max_table_positions=max_table_positions,
             attn_backend=attn_backend,
         )
         chunk_tokens = sample_tokens(
-            chunk_logits, chunk_key, c_temp, c_top_p, c_min_p,
-            top_window=sampling_top_window,
+            chunk_logits, None, c_temp, c_top_p, c_min_p,
+            top_window=sampling_top_window, top_k=c_top_k,
+            row_keys=fold_row_keys(c_seeds, c_ctx),
         )
     span_logits, k_cache, v_cache = prefill_paged(
         params, cfg, span_ids, span_positions, k_cache, v_cache,
@@ -989,16 +1012,12 @@ def spec_window(  # distlint: traced
         max_table_positions=max_table_positions, all_logits=True,
         attn_backend=attn_backend,
     )
-    b, s, vocab = span_logits.shape
-    flat_tokens = sample_tokens(
-        span_logits.reshape(b * s, vocab),
-        key,
-        jnp.repeat(temperature, s),
-        jnp.repeat(top_p, s),
-        jnp.repeat(min_p, s),
+    packed = verify_spans(
+        span_logits, span_ids, span_lens, span_positions,
+        temperature, top_p, min_p, top_k, seeds,
         top_window=sampling_top_window,
     )
-    return flat_tokens.reshape(b, s), k_cache, v_cache, chunk_tokens
+    return packed, k_cache, v_cache, chunk_tokens
 
 
 def logits(params: dict, cfg: MistralConfig, hidden: jnp.ndarray) -> jnp.ndarray:  # distlint: traced

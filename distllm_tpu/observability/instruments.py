@@ -263,8 +263,21 @@ SPEC_DRAFT_TOKENS = _registry.counter(
 )
 SPEC_ACCEPTED_TOKENS = _registry.counter(
     'distllm_engine_spec_accepted_tokens_total',
-    'Draft tokens accepted by the greedy verification rule — each one a '
-    'decode token that skipped its weight pass.',
+    'Draft tokens accepted by the verification rule (greedy argmax '
+    'comparison or sampled rejection sampling) — each one a decode token '
+    'that skipped its weight pass.',
+)
+SPEC_SAMPLED_ROWS = _registry.counter(
+    'distllm_engine_spec_sampled_rows_total',
+    'Verify-window rows with temperature > 0 that carried drafts — the '
+    'device-side rejection-sampling verification path '
+    '(docs/speculative.md "Sampled verification").',
+)
+SPEC_RESAMPLED_TOKENS = _registry.counter(
+    'distllm_engine_spec_resampled_tokens_total',
+    'Residual resamples: sampled rows whose span stopped short of its '
+    'drafts, emitting one correction token drawn from the normalized '
+    'positive residual (p - q)+.',
 )
 SPEC_ACCEPT_RATE = _registry.histogram(
     'distllm_engine_spec_accept_rate',
@@ -410,7 +423,9 @@ FLIGHT_KINDS = frozenset({
     'decode',   # one fused decode window, dispatch -> host fetch
     'mixed',    # decode window that also carried prefill-chunk rows
     'spec',     # speculative verify window (draft/accepted token fields;
-                # carries prefill_tokens/prefill_rows when chunk rows rode)
+                # sampled_rows/resampled_tokens when temperature > 0 rows
+                # rode the rejection-sampling verifier, and
+                # prefill_tokens/prefill_rows when chunk rows rode)
     'request',  # per-request lifecycle summary at finish
     'preempt',  # recompute preemption performed by prepare_decode
     'spill',    # evicted prefix blocks fetched device→host into the KV

@@ -1,4 +1,5 @@
-"""Token sampling: temperature, top-p, min-p, greedy — vectorized and jitted.
+"""Token sampling & speculative verification: temperature, top-p/top-k,
+min-p, rejection sampling — vectorized and jitted.
 
 Reference parity: vLLM ``SamplingParams`` as configured by
 ``generate/generators/vllm_backend.py:48-60`` (temperature, max_tokens, and
@@ -14,7 +15,8 @@ sort). Probabilities always use the full-vocab logsumexp normalizer, so
 top-p prefixes and min-p thresholds are exact whenever the top-p cutoff
 falls inside the window; min-p is a pure log-space comparison
 (``prob >= min_p * max_prob  <=>  logit >= max_logit + log(min_p)``) — no
-softmax materialization.
+softmax materialization. Per-request ``top_k`` is a rank mask over the same
+descending window (0 disables, a bitwise no-op).
 
 Why a window at all: XLA's TPU sort over V=32k is a multi-pass bitonic
 network, paid once per decode step inside a 16-step window scan. A
@@ -22,6 +24,15 @@ network, paid once per decode step inside a 16-step window scan. A
 ``top_k`` semantic, applied before top-p) replaces it with one
 ``lax.top_k`` pass. The library default is 0 (= exact) to preserve
 reference parity for pure-temperature sampling.
+
+PRNG contract (docs/speculative.md "Sampled verification"): the draw for
+the token at absolute sequence index ``i`` of a request uses
+``fold_in(fold_in(PRNGKey(request_seed), i), tag)``. ``_ACCEPT_FOLD`` tags
+the speculative accept/reject uniform; ``_SAMPLE_FOLD`` tags every
+categorical draw (ordinary sampling, residual resampling, and the bonus
+token). Because the key depends only on (request seed, token index), a
+request's sampled stream is deterministic per (seed, schedule) and
+identical across decode_window / mixed_window / spec_window dispatch.
 """
 
 from __future__ import annotations
@@ -29,19 +40,44 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+_ACCEPT_FOLD = 1
+_SAMPLE_FOLD = 2
 
-def sample_tokens(  # distlint: traced
+
+def fold_row_keys(  # distlint: traced
+    seeds: jnp.ndarray,  # [B] uint32 per-request seeds
+    counters: jnp.ndarray,  # [B] int32 absolute token indices
+    fold: int = _SAMPLE_FOLD,
+) -> jax.Array:
+    """Derive one PRNG key per row from (seed, token counter, tag).
+
+    Counter-based rather than split-based: the key for a draw is a pure
+    function of the request seed and the absolute index of the token being
+    produced, so replays and cross-dispatch paths (decode scan vs. spec
+    verify) agree bit-for-bit.
+    """
+
+    def one(seed, counter):
+        key = jax.random.PRNGKey(seed)
+        return jax.random.fold_in(jax.random.fold_in(key, counter), fold)
+
+    return jax.vmap(one)(seeds, counters)
+
+
+def filter_logits(  # distlint: traced
     logits: jnp.ndarray,  # [B, V] fp32
-    key: jax.Array,
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B] (1.0 disables)
     min_p: jnp.ndarray,  # [B] (0.0 disables)
+    top_k: jnp.ndarray | None = None,  # [B] int32 (0 disables)
     top_window: int = 0,
-) -> jnp.ndarray:
-    """Per-sequence sampling; temperature == 0 rows are greedy.
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Temperature-scale and filter logits; shared by sampling and verify.
 
-    ``top_window > 0`` caps the kept set at that many tokens (see module
-    docstring); ``0`` or ``>= V`` is exact.
+    Returns ``(filtered, top_idx)``: the temperature-scaled logits over the
+    descending ``top_window`` set with every filtered-out entry at ``-inf``
+    (categorical over ``filtered`` samples the served distribution), and the
+    vocab indices of that window. At least one token always survives.
     """
     vocab = logits.shape[-1]
     k = vocab if top_window <= 0 else min(top_window, vocab)
@@ -66,8 +102,42 @@ def sample_tokens(  # distlint: traced
         jnp.maximum(min_p, 0.0)
     )[:, None]
     filtered = jnp.where(top_vals >= min_p_threshold, filtered, -jnp.inf)
+    if top_k is not None:
+        # Rank mask over the descending window; intersects with top-p/min-p
+        # rather than renormalizing first, so top_k == 0 is a bitwise no-op.
+        eff = jnp.where(top_k > 0, jnp.minimum(top_k, k), k)
+        keep = jnp.arange(k)[None, :] < eff[:, None]
+        filtered = jnp.where(keep, filtered, -jnp.inf)
+    return filtered, top_idx
 
-    choice = jax.random.categorical(key, filtered, axis=-1)
+
+def sample_tokens(  # distlint: traced
+    logits: jnp.ndarray,  # [B, V] fp32
+    key: jax.Array | None,
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B] (1.0 disables)
+    min_p: jnp.ndarray,  # [B] (0.0 disables)
+    top_window: int = 0,
+    top_k: jnp.ndarray | None = None,  # [B] int32 (0 disables)
+    row_keys: jax.Array | None = None,  # [B] keys from fold_row_keys
+) -> jnp.ndarray:
+    """Per-sequence sampling; temperature == 0 rows are greedy.
+
+    ``top_window > 0`` caps the kept set at that many tokens (see module
+    docstring); ``0`` or ``>= V`` is exact. With ``row_keys`` each row draws
+    from its own counter-derived key (the engine's deterministic path);
+    otherwise one batch ``key`` feeds a single categorical (legacy path).
+    """
+    filtered, top_idx = filter_logits(
+        logits, temperature, top_p, min_p, top_k=top_k,
+        top_window=top_window,
+    )
+    if row_keys is not None:
+        choice = jax.vmap(
+            lambda rk, row: jax.random.categorical(rk, row)
+        )(row_keys, filtered)
+    else:
+        choice = jax.random.categorical(key, filtered, axis=-1)
     sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
     return jnp.where(temperature > 0, sampled, top_idx[:, 0]).astype(
         jnp.int32
@@ -76,15 +146,108 @@ def sample_tokens(  # distlint: traced
 
 def sample_tokens_windowed(  # distlint: traced
     logits: jnp.ndarray,
-    key: jax.Array,
+    key: jax.Array | None,
     temperature: jnp.ndarray,
     top_p: jnp.ndarray,
     min_p: jnp.ndarray,
     top_window: int,
+    top_k: jnp.ndarray | None = None,
+    row_keys: jax.Array | None = None,
 ) -> jnp.ndarray:
     """Alias for :func:`sample_tokens` with an explicit window (kept for
     call sites that always window)."""
     return sample_tokens(
         logits, key, temperature, top_p, min_p,
-        top_window=max(1, top_window),
+        top_window=max(1, top_window), top_k=top_k, row_keys=row_keys,
     )
+
+
+def verify_spans(  # distlint: traced
+    span_logits: jnp.ndarray,  # [B, S, V] fp32, all_logits=True span scores
+    span_ids: jnp.ndarray,  # [B, S] int32: [last committed, draft_1..m]
+    span_lens: jnp.ndarray,  # [B] int32: 1 + m (0 = inactive row)
+    span_positions: jnp.ndarray,  # [B, S] int32 absolute span positions
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    min_p: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32
+    seeds: jnp.ndarray,  # [B] uint32 per-request seeds
+    top_window: int = 0,
+) -> jnp.ndarray:
+    """Device-side speculative verification (rejection sampling).
+
+    Standard speculative-sampling rule over the *served* (filtered target)
+    distribution p̃ with the prompt-lookup point-mass proposal q: accept
+    draft d_i with probability min(1, p̃(d_i)/q(d_i)) = p̃(d_i); on
+    rejection sample the normalized positive residual (p̃ − q)+ — p̃ with
+    the draft masked out — and stop the span. Greedy rows (temperature
+    <= 0) keep the exact pre-existing argmax semantics bit-for-bit:
+    out[i] = argmax and a draft is accepted iff it equals that argmax.
+
+    Returns packed ``[B, S+1]`` int32: ``out`` tokens per span position
+    followed by ``accept_len`` (number of leading accepted drafts, in
+    [0, m]). The host emits ``out[0..accept_len]`` inclusive —
+    ``out[accept_len]`` is the residual correction, or the bonus token
+    sampled from the full filtered target when every draft was accepted.
+    """
+    b, s, vocab = span_logits.shape
+    flat = span_logits.reshape(b * s, vocab)
+
+    def rep(x):
+        return jnp.repeat(x, s)
+
+    filtered, top_idx = filter_logits(
+        flat, rep(temperature), rep(top_p), rep(min_p), top_k=rep(top_k),
+        top_window=top_window,
+    )
+    kw = filtered.shape[-1]
+    # The token produced at span position i has absolute index pos_i + 1 —
+    # the same counter the decode scan uses for that token, so sampled
+    # streams agree across dispatch flavors.
+    counters = (span_positions + 1).astype(jnp.int32).reshape(b * s)
+    u_keys = fold_row_keys(rep(seeds), counters, _ACCEPT_FOLD)
+    s_keys = fold_row_keys(rep(seeds), counters, _SAMPLE_FOLD)
+
+    filtered = filtered.reshape(b, s, kw)
+    top_idx = top_idx.reshape(b, s, kw)
+    cand = top_idx[:, :, 0]  # greedy candidate per position
+
+    m = jnp.maximum(span_lens - 1, 0)  # drafts per row
+    drafts = jnp.concatenate(
+        [span_ids[:, 1:], jnp.zeros((b, 1), span_ids.dtype)], axis=1
+    )
+    pos_in_draft = jnp.arange(s)[None, :] < m[:, None]
+
+    # log p̃(draft) under the filtered target; -inf when the draft fell
+    # outside the kept set (q point mass outside supp(p̃) never accepts).
+    match = top_idx == drafts[:, :, None]
+    logz = jax.scipy.special.logsumexp(filtered, axis=-1)
+    draft_val = jnp.max(jnp.where(match, filtered, -jnp.inf), axis=-1)
+    log_p_draft = draft_val - logz
+
+    u = jax.vmap(jax.random.uniform)(u_keys).reshape(b, s)
+    sampled_row = temperature[:, None] > 0
+    accept = jnp.where(sampled_row, u < jnp.exp(log_p_draft), cand == drafts)
+    accept = accept & pos_in_draft
+
+    # Residual (p̃ − q)+ for the point-mass q: p̃ with the draft masked out
+    # (categorical renormalizes). The bonus slot (past the drafts) and rows
+    # whose kept set is exactly {draft} — where acceptance is certain and
+    # the residual is empty — sample the full filtered target instead.
+    residual = jnp.where(match, -jnp.inf, filtered)
+    res_valid = jnp.any(jnp.isfinite(residual), axis=-1)
+    use_residual = pos_in_draft & res_valid
+    corr_src = jnp.where(use_residual[:, :, None], residual, filtered)
+    choice = jax.vmap(jax.random.categorical)(
+        s_keys, corr_src.reshape(b * s, kw)
+    ).reshape(b, s)
+    corr_sampled = jnp.take_along_axis(
+        top_idx, choice[:, :, None], axis=-1
+    )[:, :, 0]
+    correction = jnp.where(sampled_row, corr_sampled, cand)
+
+    out = jnp.where(accept, drafts, correction).astype(jnp.int32)
+    accept_len = jnp.sum(
+        jnp.cumprod(accept.astype(jnp.int32), axis=-1), axis=-1
+    ).astype(jnp.int32)
+    return jnp.concatenate([out, accept_len[:, None]], axis=-1)

@@ -57,7 +57,7 @@ def window_args(params_tree, B, nb, R):
         sds(kshape, jnp.bfloat16), sds((B, R), jnp.int32),
         sds((B,), jnp.int32), sds((B,), jnp.float32),
         sds((B,), jnp.float32), sds((B,), jnp.float32),
-        sds((2,), jnp.uint32),
+        sds((B,), jnp.int32), sds((B,), jnp.uint32),
     )
 
 # Match the engine's decode_layer_unroll so the seeded cache keys hit at
@@ -71,13 +71,13 @@ failures: list[str] = []
 def compile_window(params_tree, B, nb, R, backend, label):
     t = time.perf_counter()
     try:
-        fn = lambda p, i, po, c, k, v, bt, sl, tmp, tp, mp, ky: \
+        fn = lambda p, i, po, c, k, v, bt, sl, tmp, tp, mp, tk, sd: \
             mistral.decode_loop(
-                p, mcfg, i, po, k, v, bt, c, sl, tmp, tp, mp, ky,
+                p, mcfg, i, po, k, v, bt, c, sl, tmp, tp, mp, tk, sd,
                 num_steps=16, attn_backend=backend, max_table_positions=512,
                 sampling_top_window=64, layer_unroll=_LAYER_UNROLL)
         jitted = jax.jit(fn, donate_argnums=(4, 5),
-                         in_shardings=(Format(Layout.AUTO),) + (Format(),) * 11)
+                         in_shardings=(Format(Layout.AUTO),) + (Format(),) * 12)
         compiled = jitted.lower(*window_args(params_tree, B, nb, R)).compile()
         mem = compiled.memory_analysis()
         tmp_b = getattr(mem, 'temp_size_in_bytes', None)

@@ -42,6 +42,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument('--prefix-tokens', type=int, default=32)
     parser.add_argument('--slo', type=float, default=0.0,
                         help='TTFT SLO seconds (0 = no goodput accounting)')
+    parser.add_argument(
+        '--temperature', type=float, default=0.0,
+        help='sampling temperature for every request (0 = greedy; > 0 '
+             'drives the sampled decode/verification paths — outputs stay '
+             'deterministic per (seed, schedule), docs/speculative.md)')
+    parser.add_argument(
+        '--top-p', type=float, default=1.0,
+        help='nucleus filtering for sampled requests (1.0 disables)')
     parser.add_argument('--small', action='store_true',
                         help='tiny model dims (CPU smoke) instead of 7B')
     parser.add_argument('--max-num-seqs', type=int, default=None)
@@ -101,6 +109,8 @@ def main(argv: list[str] | None = None) -> int:
         warm_fraction=args.warm_fraction,
         prefix_tokens=args.prefix_tokens,
         vocab_size=model_cfg.vocab_size,
+        temperature=args.temperature,
+        top_p=args.top_p,
         cache_blocks=args.cache_blocks,
     )
     engine_cfg = EngineConfig(

@@ -69,7 +69,8 @@ def main() -> None:
     temp = jnp.full((batch,), 0.5, jnp.float32)
     top_p = jnp.full((batch,), 0.95, jnp.float32)
     min_p = jnp.full((batch,), 0.1, jnp.float32)
-    key = jax.random.PRNGKey(1)
+    top_k = jnp.zeros((batch,), jnp.int32)
+    seeds = jnp.ones((batch,), jnp.uint32)
 
     weight_gb = 2 * n_params / 1e9
     print(f'batch={batch} ctx={ctx} weights={weight_gb:.1f} GB')
@@ -85,9 +86,10 @@ def main() -> None:
         cases.append((be, serving_steps, 64, True))
     for backend, num_steps, top_window, unroll in cases:
             fn = jax.jit(
-                lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky, ns=num_steps,
+                lambda p, i, po, c, k, v, bt, sl, t, tp, mp, tk, sd,
+                       ns=num_steps,
                        be=backend, tw=top_window, un=unroll: mistral.decode_loop(
-                    p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                    p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, tk, sd,
                     num_steps=ns, attn_backend=be, max_table_positions=512,
                     sampling_top_window=tw, layer_unroll=un,
                 ),
@@ -103,7 +105,7 @@ def main() -> None:
                 t0 = time.perf_counter()
                 out = fn(params, ids, positions, context_lens, k_cache,
                          v_cache, block_tables, steps_left, temp, top_p,
-                         min_p, key)
+                         min_p, top_k, seeds)
                 tokens, k_cache, v_cache, _ = out
                 np.asarray(tokens)
                 compile_s = time.perf_counter() - t0
@@ -117,7 +119,7 @@ def main() -> None:
                     tokens, k_cache, v_cache, _ = fn(
                         params, ids, positions, context_lens, k_cache,
                         v_cache, block_tables, steps_left, temp, top_p,
-                        min_p, key)
+                        min_p, top_k, seeds)
                     outs.append(tokens)
                 for t in outs:
                     np.asarray(t)

@@ -73,24 +73,24 @@ def main() -> None:
         sds(kshape, jnp.bfloat16), sds((B, R), jnp.int32),
         sds((B,), jnp.int32), sds((B,), jnp.float32),
         sds((B,), jnp.float32), sds((B,), jnp.float32),
-        sds((2,), jnp.uint32),
+        sds((B,), jnp.int32), sds((B,), jnp.uint32),
     )
     floor_s = steps * 2 * n_params / HBM_BW
 
     for backend, unroll in (
         ('pallas', False), ('pallas', True), ('xla', False), ('xla', True)
     ):
-        def fn(p, i, po, c, k, v, bt, sl, tmp, tp, mp, ky, be=backend,
+        def fn(p, i, po, c, k, v, bt, sl, tmp, tp, mp, tk, sd, be=backend,
                un=unroll):
             return mistral.decode_loop(
-                p, mcfg, i, po, k, v, bt, c, sl, tmp, tp, mp, ky,
+                p, mcfg, i, po, k, v, bt, c, sl, tmp, tp, mp, tk, sd,
                 num_steps=steps, attn_backend=be, max_table_positions=512,
                 sampling_top_window=64, layer_unroll=un,
             )
 
         jitted = jax.jit(
             fn, donate_argnums=(4, 5),
-            in_shardings=(Format(Layout.AUTO),) + (Format(),) * 11,
+            in_shardings=(Format(Layout.AUTO),) + (Format(),) * 12,
         )
         compiled = jitted.lower(*args).compile()
         cost = compiled.cost_analysis() or {}

@@ -88,14 +88,15 @@ def main() -> None:
         top_p = jnp.full((batch,), 0.95, jnp.float32)
         min_p = jnp.full((batch,), 0.1, jnp.float32)
         steps_left = jnp.full((batch,), num_steps, jnp.int32)
-        key = jax.random.PRNGKey(1)
+        top_k = jnp.zeros((batch,), jnp.int32)
+        seeds = jnp.ones((batch,), jnp.uint32)
 
         for qmm_backend, attn_backend in cases:
             qmm.set_default_backend(qmm_backend)
             fn = jax.jit(
-                lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky, ab=attn_backend: (
+                lambda p, i, po, c, k, v, bt, sl, t, tp, mp, tk, sd, ab=attn_backend: (
                     mistral.decode_loop(
-                        p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                        p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, tk, sd,
                         num_steps=num_steps, attn_backend=ab,
                         max_table_positions=512, sampling_top_window=64,
                         layer_unroll=True,
@@ -109,7 +110,8 @@ def main() -> None:
                 t0 = time.perf_counter()
                 tokens, k_cache, v_cache, _ = fn(
                     params, ids, positions, context_lens, k_cache, v_cache,
-                    block_tables, steps_left, temp, top_p, min_p, key,
+                    block_tables, steps_left, temp, top_p, min_p, top_k,
+                    seeds,
                 )
                 np.asarray(tokens)
                 compile_s = time.perf_counter() - t0
@@ -120,7 +122,7 @@ def main() -> None:
                     tokens, k_cache, v_cache, _ = fn(
                         params, ids, positions, context_lens, k_cache,
                         v_cache, block_tables, steps_left, temp, top_p,
-                        min_p, key,
+                        min_p, top_k, seeds,
                     )
                     outs.append(tokens)
                 for t in outs:

@@ -32,12 +32,13 @@ def analyze(num_steps, attn_backend, num_blocks=488, b=24, sample=True):
         bt=jax.ShapeDtypeStruct((b, R), jnp.int32),
         steps=jax.ShapeDtypeStruct((b,), jnp.int32),
         f=jax.ShapeDtypeStruct((b,), jnp.float32),
-        key=jax.ShapeDtypeStruct((2,), jnp.uint32),
+        tk=jax.ShapeDtypeStruct((b,), jnp.int32),
+        sd=jax.ShapeDtypeStruct((b,), jnp.uint32),
     )
 
-    def fn(params, ids, pos, ctx, k, v, bt, steps, t, tp, mp, key):
+    def fn(params, ids, pos, ctx, k, v, bt, steps, t, tp, mp, tk, sd):
         return mistral.decode_loop(
-            params, cfg, ids, pos, k, v, bt, ctx, steps, t, tp, mp, key,
+            params, cfg, ids, pos, k, v, bt, ctx, steps, t, tp, mp, tk, sd,
             num_steps=num_steps, attn_backend=attn_backend,
             max_table_positions=512,
         )
@@ -45,7 +46,7 @@ def analyze(num_steps, attn_backend, num_blocks=488, b=24, sample=True):
     lowered = jax.jit(fn, donate_argnums=(4, 5)).lower(
         shapes['params'], shapes['ids'], shapes['pos'], shapes['ctx'],
         shapes['k'], shapes['v'], shapes['bt'], shapes['steps'],
-        shapes['f'], shapes['f'], shapes['f'], shapes['key'],
+        shapes['f'], shapes['f'], shapes['f'], shapes['tk'], shapes['sd'],
     )
     compiled = lowered.compile()
     try:

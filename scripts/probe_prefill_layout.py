@@ -30,18 +30,18 @@ shapes = [
     S((L, num_blocks, bs, kv, hd), jnp.bfloat16),
     S((b, R), jnp.int32), S((b,), jnp.int32),
     S((b,), jnp.float32), S((b,), jnp.float32), S((b,), jnp.float32),
-    S((2,), jnp.uint32),
+    S((b,), jnp.int32), S((b,), jnp.uint32),
 ]
 
 
-def window(params, ids, pos, ctx, k, v, bt, steps, t, tp, mp, key):
+def window(params, ids, pos, ctx, k, v, bt, steps, t, tp, mp, tk, sd):
     return mistral.decode_loop(
-        params, cfg, ids, pos, k, v, bt, ctx, steps, t, tp, mp, key,
+        params, cfg, ids, pos, k, v, bt, ctx, steps, t, tp, mp, tk, sd,
         num_steps=16, attn_backend='xla', max_table_positions=512,
     )
 
 
-in_sh = (Format(Layout.AUTO),) + (Format(),) * 11
+in_sh = (Format(Layout.AUTO),) + (Format(),) * 12
 compiled = jax.jit(window, donate_argnums=(4, 5), in_shardings=in_sh).lower(
     *shapes
 ).compile()

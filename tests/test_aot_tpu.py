@@ -112,10 +112,10 @@ def test_decode_window_compiles_for_tpu(v5e, backend):
         compiled = _compile(
             mosaic_kernel=(backend == 'pallas'),
             build=lambda un=layer_unroll: jax.jit(
-                lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky,
+                lambda p, i, po, c, k, v, bt, sl, t, tp, mp, tk, sd,
                        un=un:
                     mistral.decode_loop(
-                        p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                        p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, tk, sd,
                         num_steps=4, attn_backend=backend,
                         max_table_positions=256,
                         sampling_top_window=16, layer_unroll=un,
@@ -127,7 +127,7 @@ def test_decode_window_compiles_for_tpu(v5e, backend):
                 v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
                 v5e((b,), jnp.int32), v5e((b,), jnp.float32),
                 v5e((b,), jnp.float32), v5e((b,), jnp.float32),
-                v5e((2,), jnp.uint32),
+                v5e((b,), jnp.int32), v5e((b,), jnp.uint32),
             ).compile()
         )
         mem = compiled.memory_analysis()
@@ -251,9 +251,9 @@ def test_int8_decode_window_compiles_for_tpu(v5e):
     kshape = (cfg.num_layers, nb, bs, cfg.num_kv_heads, cfg.head_size)
     compiled = _compile(
         lambda: jax.jit(
-            lambda p, i, po, c, k, v, bt, sl, t, tp, mp, ky:
+            lambda p, i, po, c, k, v, bt, sl, t, tp, mp, tk, sd:
                 mistral.decode_loop(
-                    p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, ky,
+                    p, cfg, i, po, k, v, bt, c, sl, t, tp, mp, tk, sd,
                     num_steps=4, attn_backend='pallas',
                     max_table_positions=256,
                     sampling_top_window=16,
@@ -265,7 +265,7 @@ def test_int8_decode_window_compiles_for_tpu(v5e):
             v5e(kshape, jnp.bfloat16), v5e((b, rows), jnp.int32),
             v5e((b,), jnp.int32), v5e((b,), jnp.float32),
             v5e((b,), jnp.float32), v5e((b,), jnp.float32),
-            v5e((2,), jnp.uint32),
+            v5e((b,), jnp.int32), v5e((b,), jnp.uint32),
         ).compile()
     )
     mem = compiled.memory_analysis()

@@ -261,17 +261,16 @@ def test_engine_warmup_compiles_without_state_damage():
     """warmup() must not disturb scheduler state, the sampling RNG stream,
     or later generations."""
     cfg, params, engine = _tiny_engine()
-    key_before = engine._key
     engine.warmup()
     assert engine.sched.num_running == 0
     assert engine.sched.num_free_blocks == 63  # all but trash block 0
-    assert (np.asarray(engine._key) == np.asarray(key_before)).all()
     prompts = [[5, 9, 12], [7, 3, 22, 31]]
     outs = engine.generate_ids(prompts, SamplingParams(temperature=0.0, max_tokens=4))
     for prompt, out in zip(prompts, outs):
         assert out == _dense_greedy_reference(cfg, params, prompt, 4)
     # Seeded stochastic sampling reproduces between warmed/unwarmed engines
-    # (both straight out of construction; warmup must not advance the key).
+    # (keys are counter-derived per request, so warmup cannot advance any
+    # sampling stream — docs/speculative.md "Sampled verification").
     _, _, warmed = _tiny_engine()
     warmed.warmup()
     _, _, fresh = _tiny_engine()
@@ -900,13 +899,12 @@ def test_mixed_windows_warmup_compiles_without_state_damage():
     _, on = _mixed_ab_engines(
         cfg, mistral.init, prefill_chunk_tokens=4, max_model_len=32,
     )
-    key_before = on._key
     on.warmup()
     assert on.sched.num_running == 0
     assert on.sched.num_free_blocks == 95
-    assert (np.asarray(on._key) == np.asarray(key_before)).all()
-    # Short post-warmup serve must still match the dense gold path (the
-    # sampling stream and scheduler state were untouched by warmup).
+    # Short post-warmup serve must still match the dense gold path
+    # (scheduler state was untouched by warmup; sampling keys are
+    # counter-derived per request, so there is no RNG state to damage).
     prompts = [[5, 9, 12], [7, 3, 22, 31, 40, 2, 17]]
     outs = on.generate_ids(
         prompts, SamplingParams(temperature=0.0, max_tokens=4)

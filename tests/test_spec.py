@@ -383,19 +383,26 @@ def test_acceptance_preemption_mid_draft():
     assert eng.sched.num_free_blocks == 13  # no leaks
 
 
-def test_temperature_rows_fall_back_to_no_drafting():
-    """Stochastic rows never draft (greedy-only acceptance); they still
-    generate their full budget through span-1 verify windows."""
+def test_temperature_rows_draft_with_sampled_verification():
+    """Stochastic rows draft too: device-side rejection sampling verifies
+    their spans (docs/speculative.md "Sampled verification"). The stub
+    drafter guarantees proposals regardless of what the sampled history
+    looks like (prompt-lookup matches would be luck on a random model)."""
     cfg = _tiny_cfg()
     params = mistral.init(jax.random.PRNGKey(0), cfg)
     eng = _engine(cfg, params, draft_k=4)
+    prompt = [5, 9, 12, 5, 9, 12]
     rid = eng.add_request(
-        [5, 9, 12, 5, 9, 12], SamplingParams(temperature=0.9, max_tokens=7)
+        prompt, SamplingParams(temperature=0.9, max_tokens=7)
     )
-    assert eng._requests[rid].drafter is None
+    # Sampled rows get the real prompt-lookup drafter attached now (the
+    # old greedy-only gate is gone) ...
+    assert eng._requests[rid].drafter is not None
+    # ... which the stub then replaces so drafting is deterministic here.
+    _force_drafts(eng, rid, [7] * 16, len(prompt))
     eng._run_to_completion()
     assert len(eng._finished.pop(rid).output_ids) == 7
-    assert eng._stats.get('spec_draft_tokens', 0) == 0
+    assert eng._stats.get('spec_draft_tokens', 0) > 0
     assert eng._stats['spec_windows'] > 0
 
 
@@ -526,16 +533,19 @@ def test_spec_config_validation():
     ).draft_k == 4
 
 
-def test_tpu_generator_config_rejects_spec_with_temperature():
+def test_tpu_generator_config_allows_spec_with_temperature():
+    # Sampled verification lifted the old greedy-only rejection: draft_k
+    # composes with temperature > 0 (docs/speculative.md "Sampled
+    # verification").
     from distllm_tpu.generate.generators.tpu_backend import (
         TpuGeneratorConfig,
     )
 
-    with pytest.raises(ValueError, match='greedy-only'):
-        TpuGeneratorConfig(
-            pretrained_model_name_or_path='/tmp/x', temperature=0.5,
-            draft_k=4,
-        )
+    cfg = TpuGeneratorConfig(
+        pretrained_model_name_or_path='/tmp/x', temperature=0.5,
+        draft_k=4,
+    )
+    assert cfg.draft_k == 4
     cfg = TpuGeneratorConfig(
         pretrained_model_name_or_path='/tmp/x', temperature=0.0, draft_k=4,
     )
