@@ -1582,6 +1582,578 @@ def _stage_gen_tier() -> dict:
     return out
 
 
+def _stage_gen_router() -> dict:
+    """Multi-replica router stage (docs/routing.md): in-process chat_server
+    replicas behind the prefix-affinity router, proven against a
+    round-robin control plus a replica-kill failover arm and a direct
+    peer-KV-handoff arm.
+
+    Replicas always run at smoke-scale model dims — N engines share ONE
+    process and ONE accelerator here, so the stage measures the routing
+    and tier deltas (which are dimension-independent), never model FLOPs;
+    the non-small tier only widens the workload.
+
+    Four arms:
+
+    - **round_robin** (control): every warm session's prefix lands on
+      alternating replicas, so each replica re-prefills (and, with the
+      pool below the union working set, churns) prefixes a peer already
+      holds;
+    - **prefix_affinity**: the router learns residency from the
+      ``X-Distllm-Prefix-Digest`` response headers and pins each session
+      to one replica — warm-repeat TTFT must beat the control
+      (``router_warm_ttft_speedup > 1.0``);
+    - **failover**: one of three replicas is killed mid-run with health
+      probes effectively off — discovery happens on the proxy path, the
+      caught request retries ONCE on a healthy peer (``retried >= 1``),
+      goodput stays > 0, zero quarantines, and every survivor answer is
+      token-identical to the control arm's answer for the same arrival
+      (greedy fp32, same weights: content depends only on the prompt);
+    - **peer handoff** (no HTTP): engine A spills a warm prefix to its
+      host tier and serves it over the fabric
+      (``peer_kv_serve_endpoint``); engine B, cold but configured with
+      ``peer_kv_endpoints``, adopts A's blocks like a disk promotion
+      (``>= 1`` peer fetch) and must emit tokens bit-identical to a
+      peer-less control engine C.
+
+    Per-replica flight rings from the affinity arm are dumped and merged
+    into one Perfetto trace (``aggregate.write_combined_perfetto`` — the
+    replica-id process naming this PR adds). ``DISTLLM_BENCH_ROUTER=0``
+    skips the stage.
+    """
+    prefix = 'gen_router_'
+    if os.environ.get('DISTLLM_BENCH_ROUTER', '1') in ('', '0'):
+        return {f'{prefix}skipped': 'DISTLLM_BENCH_ROUTER=0'}
+
+    import socket
+    import threading
+    import zlib
+
+    import jax
+    import requests
+    from aiohttp import web
+
+    from distllm_tpu.chat import ChatAppConfig
+    from distllm_tpu.chat_server import build_app
+    from distllm_tpu.generate.engine.engine import EngineConfig, SamplingParams
+    from distllm_tpu.generate.loadgen import (
+        LoadgenConfig,
+        build_workload,
+        run_http_loadgen,
+    )
+    from distllm_tpu.models import mistral
+    from distllm_tpu.observability import instruments
+    from distllm_tpu.observability.aggregate import write_combined_perfetto
+    from distllm_tpu.observability.flight import FlightRecorder
+    from distllm_tpu.observability.metrics import quantile_from_cumulative
+    from distllm_tpu.router import RouterConfig, build_router_app
+
+    # N replicas in one process: one metric-history sampler per app would
+    # stack 5+ background threads for nothing this stage reads.
+    os.environ['DISTLLM_HISTORY_S'] = '0'
+
+    small = bool(os.environ.get('DISTLLM_BENCH_SMALL'))
+    # fp32 everywhere: the failover and peer arms gate on token IDENTITY
+    # across separately built engines.
+    model_cfg = mistral.MistralConfig(
+        vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
+        num_kv_heads=4, intermediate_size=512, dtype='float32',
+    )
+    max_num_seqs, num_blocks, max_model_len, decode_steps = 3, 48, 256, 4
+    # Pool arithmetic mirrors gen_tier: 6 sessions x 9 shared full prefix
+    # blocks (the 'user:'-prefixed 144-id prefix) = 54 > 47 usable, so one
+    # replica holding ALL sessions (round-robin) churns; an affinity
+    # partition of ~3 sessions/replica (27 blocks) stays resident. The
+    # arrival rate is low enough that responses (and therefore learned
+    # digests) land before most warm repeats fire — affinity needs the
+    # headers to have come back.
+    load_cfg = LoadgenConfig(
+        seed=0,
+        num_requests=32 if small else 96,
+        rate_rps=2.0 if small else 4.0,
+        num_sessions=6, warm_fraction=0.75, prefix_tokens=144,
+        prompt_tokens=(8, 16), output_tokens=(4, 10),
+        vocab_size=model_cfg.vocab_size,
+    )
+    workload = build_workload(load_cfg)
+    seen_sessions: set = set()
+    warm_repeat_idx: list[int] = []
+    for i, arrival in enumerate(workload):
+        if arrival.session is None:
+            continue
+        if arrival.session in seen_sessions:
+            warm_repeat_idx.append(i)
+        seen_sessions.add(arrival.session)
+    last_at = max(a.at_s for a in workload)
+
+    class _EngineChatGenerator:
+        """Replica backend: deterministic word-hash tokenizer over the
+        rendered chat prompt + greedy engine decode. Exposes ``.engine``
+        for the ``/loadinfo`` probe. Two replicas with the same weights
+        answer any prompt identically — the failover identity gate."""
+
+        def __init__(self, engine, vocab_size: int, max_tokens: int = 8):
+            self.engine = engine
+            self.vocab_size = vocab_size
+            self.max_tokens = max_tokens
+
+        def _ids(self, prompt: str) -> list[int]:
+            ids = []
+            for word in prompt.split():
+                if word.isdigit():
+                    ids.append(int(word) % (self.vocab_size - 2) + 1)
+                else:
+                    ids.append(
+                        zlib.crc32(word.encode()) % (self.vocab_size - 1) + 1
+                    )
+            return ids
+
+        def generate(self, prompts: list[str]) -> list[str]:
+            outs = self.engine.generate_ids(
+                [self._ids(p) for p in prompts],
+                SamplingParams(temperature=0.0,
+                               max_tokens=self.max_tokens),
+            )
+            return [' '.join(str(t) for t in out) for out in outs]
+
+    from typing import ClassVar
+
+    class _ReplicaChatConfig(ChatAppConfig):
+        """ChatAppConfig whose generator is a pre-built in-process engine
+        wrapper (keyed off-model: pydantic configs must stay YAML-shaped,
+        a live engine is not a field)."""
+
+        replica_key: int = 0
+        _live_generators: ClassVar[dict] = {}
+
+        def build_generator(self):
+            return type(self)._live_generators[self.replica_key]
+
+    def _serve_app(app) -> tuple[str, 'callable']:
+        """Boot an aiohttp app on a free port in a daemon thread; returns
+        ``(base_url, idempotent_stop)`` (tests/test_chat.py pattern)."""
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            port = s.getsockname()[1]
+        holder: dict = {}
+
+        def run():
+            import asyncio
+
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            holder['loop'] = loop
+            # Short shutdown grace: the failover arm kills a replica
+            # mid-run and needs the port gone NOW, not in 60 s.
+            runner = web.AppRunner(app, shutdown_timeout=1.0)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, '127.0.0.1', port)
+            loop.run_until_complete(site.start())
+            holder['runner'] = runner
+            loop.run_forever()
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        for _ in range(100):
+            try:
+                requests.get(f'http://127.0.0.1:{port}/health', timeout=1)
+                break
+            except Exception:
+                time.sleep(0.05)
+
+        done = {'stopped': False}
+
+        def stop():
+            if done['stopped']:
+                return
+            done['stopped'] = True
+            loop = holder['loop']
+
+            async def _shutdown():
+                await holder['runner'].cleanup()
+                loop.stop()
+
+            loop.call_soon_threadsafe(
+                lambda: loop.create_task(_shutdown())
+            )
+            thread.join(timeout=10)
+
+        return f'http://127.0.0.1:{port}', stop
+
+    replica_counter = {'next': 0}
+
+    def _build_replica(engine_cfg: EngineConfig):
+        """One replica: fresh engine (+ its own flight ring) behind its
+        own chat_server app. Returns (engine, url, stop, reason)."""
+        engine, reason = _build_engine_with_fallback(
+            model_cfg,
+            engine_cfg,
+            lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+            [[1, 2, 3]],
+            SamplingParams(temperature=0.0, max_tokens=2),
+        )
+        engine.flight = FlightRecorder()
+        key = replica_counter['next']
+        replica_counter['next'] += 1
+        _ReplicaChatConfig._live_generators[key] = _EngineChatGenerator(
+            engine, model_cfg.vocab_size
+        )
+        url, stop = _serve_app(
+            build_app(_ReplicaChatConfig(replica_key=key))
+        )
+        return engine, url, stop, reason
+
+    def _replica_engine_cfg() -> EngineConfig:
+        return EngineConfig(
+            block_size=16, num_blocks=num_blocks,
+            max_num_seqs=max_num_seqs, max_model_len=max_model_len,
+            decode_steps=decode_steps, pipeline_depth=2,
+            sampling_top_window=64, enable_prefix_cache=True,
+            attribution=True,
+        )
+
+    def _counter_total(counter) -> float:
+        return sum(child.value for _, child in counter.children())
+
+    bundle = _bundle_dir('gen_router')
+    os.makedirs(bundle, exist_ok=True)
+    cache_before = _cache_entries()
+    warmup_total = 0.0
+    fallback_reason = None
+    arm_stats: dict[str, dict] = {}
+    flight_paths: list[str] = []
+
+    def _run_router_arm(
+        arm: str, policy: str, n_replicas: int, kill_idx: int | None = None
+    ) -> dict:
+        nonlocal warmup_total, fallback_reason
+        engines, stops, urls = [], [], []
+        warmup_start = time.perf_counter()
+        try:
+            for _ in range(n_replicas):
+                engine, url, stop, reason = _build_replica(
+                    _replica_engine_cfg()
+                )
+                engines.append(engine)
+                urls.append(url)
+                stops.append(stop)
+                fallback_reason = fallback_reason or reason
+            warmup_total += time.perf_counter() - warmup_start
+            router_cfg = RouterConfig(
+                replicas=tuple(urls),
+                policy=policy,
+                loadinfo_ttl_s=0.05,
+                # Failover: probes effectively off, so the kill is
+                # DISCOVERED on the proxy path (the retry contract),
+                # not masked by a lucky health tick.
+                health_interval_s=30.0 if kill_idx is not None else 0.5,
+                request_timeout_s=60.0,
+            )
+            router_url, router_stop = _serve_app(
+                build_router_app(router_cfg)
+            )
+            stops.append(router_stop)
+            decisions_before = {
+                k: child.value
+                for k, child in instruments.ROUTER_REQUESTS.children()
+            }
+            counters_before = {
+                'retries': instruments.ROUTER_RETRIES.value,
+                'quarantined': _counter_total(
+                    instruments.RESILIENCE_QUARANTINED
+                ),
+            }
+            tpot_before = instruments.REQUEST_TPOT.cumulative_counts()
+            killer = None
+            if kill_idx is not None:
+                killer = threading.Timer(
+                    max(0.5, 0.35 * last_at), stops[kill_idx]
+                )
+                killer.start()
+            try:
+                report = run_http_loadgen(
+                    router_url, workload, slo_s=0.0, timeout_s=60.0
+                )
+            finally:
+                if killer is not None:
+                    killer.cancel()
+            tpot_delta = [
+                after - before
+                for after, before in zip(
+                    instruments.REQUEST_TPOT.cumulative_counts(),
+                    tpot_before,
+                )
+            ]
+            warm_ttfts = [
+                report.ttft_by_request[i]
+                for i in warm_repeat_idx
+                if i < len(report.ttft_by_request)
+                and report.ttft_by_request[i] is not None
+                and report.statuses[i] == 200
+            ]
+            if arm == 'prefix_affinity':
+                for r, engine in enumerate(engines):
+                    path = os.path.join(bundle, f'replica-{r}')
+                    os.makedirs(path, exist_ok=True)
+                    path = os.path.join(path, 'flight.jsonl')
+                    engine.flight.dump_jsonl(path)
+                    flight_paths.append(path)
+            return {
+                'report': report,
+                'warm_ttft': (
+                    sum(warm_ttfts) / len(warm_ttfts)
+                    if warm_ttfts else None
+                ),
+                'tpot': {
+                    f'p{q}': round(
+                        quantile_from_cumulative(
+                            instruments.REQUEST_TPOT.buckets,
+                            tpot_delta, q / 100.0,
+                        ) or 0.0, 6,
+                    )
+                    for q in (50, 95, 99)
+                } if tpot_delta and tpot_delta[-1] > 0 else {},
+                'decisions': {
+                    '/'.join(k): round(
+                        child.value - decisions_before.get(k, 0.0)
+                    )
+                    for k, child in
+                    instruments.ROUTER_REQUESTS.children()
+                    if child.value > decisions_before.get(k, 0.0)
+                },
+                'retries_delta': (
+                    instruments.ROUTER_RETRIES.value
+                    - counters_before['retries']
+                ),
+                'quarantined_delta': (
+                    _counter_total(instruments.RESILIENCE_QUARANTINED)
+                    - counters_before['quarantined']
+                ),
+            }
+        finally:
+            for stop in stops:
+                stop()
+            for engine in engines:
+                engine.shutdown()
+
+    arm_stats['round_robin'] = _run_router_arm('round_robin',
+                                               'round_robin', 2)
+    arm_stats['prefix_affinity'] = _run_router_arm('prefix_affinity',
+                                                   'prefix_affinity', 2)
+    arm_stats['failover'] = _run_router_arm('failover', 'round_robin', 3,
+                                            kill_idx=0)
+
+    # ------------------------------------------------ peer handoff arm
+    # Direct engines, no HTTP: A spills a warm prefix to its host tier
+    # and serves it over the fabric; B adopts it as a peer promotion; C
+    # is the cold control the tokens must match bit-for-bit.
+    peer_prompt = [1 + (i * 7) % (model_cfg.vocab_size - 8)
+                   for i in range(150)]
+    junk_prompts = [
+        [2 + (j * 997 + i * 13) % (model_cfg.vocab_size - 8)
+         for i in range(150)]
+        for j in range(6)
+    ]
+    peer_params = SamplingParams(temperature=0.0, max_tokens=8)
+    peer_hits_before = instruments.PREFIX_TIER_HITS.labels(
+        tier='peer'
+    ).value
+
+    def _peer_engine_cfg(**overrides) -> EngineConfig:
+        cfg = _replica_engine_cfg().model_copy(
+            update={'host_kv_tier_bytes': 64 << 20, **overrides}
+        )
+        return cfg
+
+    warmup_start = time.perf_counter()
+    engine_a, reason = _build_engine_with_fallback(
+        model_cfg,
+        _peer_engine_cfg(peer_kv_serve_endpoint='tcp://127.0.0.1:0'),
+        lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+        [[1, 2, 3]],
+        SamplingParams(temperature=0.0, max_tokens=2),
+    )
+    fallback_reason = fallback_reason or reason
+    peer_summary: dict = {}
+    try:
+        engine_a.generate_ids([peer_prompt], peer_params)
+        for junk in junk_prompts:
+            engine_a.generate_ids([junk], peer_params)
+        spills_a = engine_a.tier_summary().get('spills', 0)
+
+        engine_b, reason = _build_engine_with_fallback(
+            model_cfg,
+            _peer_engine_cfg(
+                peer_kv_endpoints=(engine_a.peer_kv_endpoint,)
+            ),
+            lambda: mistral.init_on_device(
+                jax.random.PRNGKey(0), model_cfg
+            ),
+            [[1, 2, 3]],
+            SamplingParams(temperature=0.0, max_tokens=2),
+        )
+        fallback_reason = fallback_reason or reason
+        try:
+            tokens_b = engine_b.generate_ids([peer_prompt], peer_params)
+            peer_summary = {
+                **engine_b.tier_summary(),
+                'spills_a': spills_a,
+                'served_blocks_a': engine_a.tier_summary().get(
+                    'peer_served_blocks', 0
+                ),
+            }
+        finally:
+            engine_b.shutdown()
+    finally:
+        engine_a.shutdown()
+
+    engine_c, reason = _build_engine_with_fallback(
+        model_cfg,
+        _peer_engine_cfg(),
+        lambda: mistral.init_on_device(jax.random.PRNGKey(0), model_cfg),
+        [[1, 2, 3]],
+        SamplingParams(temperature=0.0, max_tokens=2),
+    )
+    fallback_reason = fallback_reason or reason
+    try:
+        tokens_c = engine_c.generate_ids([peer_prompt], peer_params)
+    finally:
+        engine_c.shutdown()
+    warmup_total += time.perf_counter() - warmup_start
+    peer_hits = (
+        instruments.PREFIX_TIER_HITS.labels(tier='peer').value
+        - peer_hits_before
+    )
+    peer_identical = tokens_b == tokens_c
+
+    # -------------------------------------------------- merged Perfetto
+    perfetto_path = os.path.join(bundle, 'combined_perfetto.json')
+    perfetto_inputs = write_combined_perfetto(flight_paths, perfetto_path)
+
+    rr, aff, failover = (
+        arm_stats['round_robin'],
+        arm_stats['prefix_affinity'],
+        arm_stats['failover'],
+    )
+    speedup = (
+        round(rr['warm_ttft'] / aff['warm_ttft'], 3)
+        if rr['warm_ttft'] and aff['warm_ttft'] else None
+    )
+    # Survivor identity: every failover 200 must carry the SAME content
+    # the control arm produced for that arrival — greedy fp32 engines
+    # built from one PRNG key answer by prompt alone, so a kill must not
+    # perturb a single survivor token.
+    survivor_identical = all(
+        content == rr['report'].contents[i]
+        for i, content in enumerate(failover['report'].contents)
+        if failover['report'].statuses[i] == 200
+        and rr['report'].statuses[i] == 200
+    )
+
+    out = {
+        f'{prefix}metric': (
+            'warm-repeat TTFT, prefix-affinity routing vs round-robin '
+            '(2 replicas)'
+        ),
+        f'{prefix}router_warm_ttft_speedup': speedup,
+        f'{prefix}affinity_warm_ttft_s': (
+            round(aff['warm_ttft'], 6) if aff['warm_ttft'] else None
+        ),
+        f'{prefix}rr_warm_ttft_s': (
+            round(rr['warm_ttft'], 6) if rr['warm_ttft'] else None
+        ),
+        f'{prefix}warm_repeats': len(warm_repeat_idx),
+        f'{prefix}failover_goodput': round(
+            failover['report'].goodput_rps, 3
+        ),
+        f'{prefix}failover_retried': failover['report'].retried,
+        f'{prefix}failover_router_retries': round(
+            failover['retries_delta']
+        ),
+        f'{prefix}failover_errors': failover['report'].errors,
+        f'{prefix}failover_quarantines': round(
+            failover['quarantined_delta']
+        ),
+        f'{prefix}failover_survivor_tokens_identical': survivor_identical,
+        f'{prefix}peer_hits': round(peer_hits),
+        f'{prefix}peer_fetched_blocks': peer_summary.get(
+            'peer_fetched_blocks'
+        ),
+        f'{prefix}peer_fetched_bytes': peer_summary.get(
+            'peer_fetched_bytes'
+        ),
+        f'{prefix}peer_served_blocks': peer_summary.get(
+            'served_blocks_a'
+        ),
+        f'{prefix}peer_spills': peer_summary.get('spills_a'),
+        f'{prefix}peer_tokens_identical': peer_identical,
+        f'{prefix}perfetto_inputs': perfetto_inputs,
+        f'{prefix}perfetto_path': perfetto_path,
+        f'{prefix}workload': _workload_fingerprint(
+            {
+                'arrivals': [
+                    [a.at_s, list(a.prompt_ids), a.max_tokens, a.session]
+                    for a in workload
+                ],
+                'engine': {'max_num_seqs': max_num_seqs,
+                           'num_blocks': num_blocks,
+                           'decode_steps': decode_steps},
+            }
+        ),
+        f'{prefix}warmup_secs': round(warmup_total, 1),
+        f'{prefix}device': str(jax.devices()[0].device_kind),
+        **_cache_fields(prefix, cache_before),
+    }
+    for arm in ('round_robin', 'prefix_affinity', 'failover'):
+        stats = arm_stats[arm]
+        report = stats['report']
+        tag = {'round_robin': 'rr', 'prefix_affinity': 'affinity',
+               'failover': 'failover'}[arm]
+        out[f'{prefix}{tag}_ok'] = report.ok
+        out[f'{prefix}{tag}_goodput_rps'] = round(report.goodput_rps, 3)
+        out[f'{prefix}{tag}_decisions'] = stats['decisions']
+        for key, value in report.percentiles.items():
+            out[f'{prefix}{tag}_{key}'] = (
+                round(value, 6) if value is not None else None
+            )
+        for key, value in stats['tpot'].items():
+            out[f'{prefix}{tag}_tpot_{key}'] = value
+
+    if speedup is None or speedup <= 1.0:
+        out[f'{prefix}error'] = (
+            f'affinity warm TTFT speedup {speedup} not > 1.0 over '
+            'round-robin — digest learning is not concentrating sessions'
+        )
+    elif failover['report'].retried < 1 or (
+        failover['report'].goodput_rps <= 0
+    ):
+        out[f'{prefix}error'] = (
+            f'failover arm retried={failover["report"].retried} '
+            f'goodput={failover["report"].goodput_rps} — the kill was '
+            'not absorbed by the retry-once contract'
+        )
+    elif failover['quarantined_delta'] or not survivor_identical:
+        out[f'{prefix}error'] = (
+            'failover perturbed the survivors '
+            f'(quarantines={failover["quarantined_delta"]}, '
+            f'identical={survivor_identical}) — a dead peer must cost '
+            'its own in-flight requests at most'
+        )
+    elif peer_hits < 1 or not peer_summary.get('peer_fetched_blocks'):
+        out[f'{prefix}error'] = (
+            'no peer-tier hit recorded — the spilled prefix never '
+            'crossed the fabric (check spills_a and the tier walk)'
+        )
+    elif not peer_identical:
+        out[f'{prefix}error'] = (
+            'peer-adopted tokens differ from the cold control — the '
+            '.kvblock payload did not round-trip byte-exactly'
+        )
+    if fallback_reason:
+        out[f'{prefix}attn_fallback_reason'] = fallback_reason
+    return out
+
+
 def _stage_gen_chaos() -> dict:
     """Chaos serving stage (docs/resilience.md): the open-loop Poisson
     loadgen driven through a DETERMINISTIC fault schedule, gating that the
@@ -2312,8 +2884,8 @@ def _chip_peak_flops(device) -> float | None:
 # expensive coverage first, never the headline metrics.
 STAGE_ORDER = (
     'embed', 'embed_q', 'gen', 'gen_prefix', 'gen_mixed', 'gen_spec',
-    'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos', 'gen_history',
-    'gen_kvq', 'gen_q',
+    'gen_kernel', 'gen_load', 'gen_tier', 'gen_router', 'gen_chaos',
+    'gen_history', 'gen_kvq', 'gen_q',
 )
 NOMINAL_BUDGET_S = {
     'embed': 1200.0,
@@ -2325,6 +2897,7 @@ NOMINAL_BUDGET_S = {
     'gen_kernel': 2700.0,
     'gen_load': 2700.0,
     'gen_tier': 2700.0,
+    'gen_router': 2700.0,
     'gen_chaos': 2700.0,
     'gen_history': 2700.0,
     'gen_kvq': 2700.0,
@@ -2332,7 +2905,8 @@ NOMINAL_BUDGET_S = {
 }
 GEN_STAGES = frozenset(
     {'gen', 'gen_q', 'gen_prefix', 'gen_mixed', 'gen_spec', 'gen_kernel',
-     'gen_load', 'gen_tier', 'gen_chaos', 'gen_history', 'gen_kvq'}
+     'gen_load', 'gen_tier', 'gen_router', 'gen_chaos', 'gen_history',
+     'gen_kvq'}
 )
 # Under a 1 h driver timeout (rc 124 in r5 was `timeout` sending SIGTERM):
 # stages stop with ~5 min to spare even if the guess is exact, and the
@@ -2579,6 +3153,7 @@ def _run_stage_entry(stage: str) -> None:
         'gen_kernel': _stage_gen_kernel,
         'gen_load': _stage_gen_load,
         'gen_tier': _stage_gen_tier,
+        'gen_router': _stage_gen_router,
         'gen_chaos': _stage_gen_chaos,
         'gen_history': _stage_gen_history,
         'gen_kvq': _stage_gen_kvq,
@@ -2606,8 +3181,8 @@ def main() -> None:
         '--stage',
         choices=[
             'embed', 'embed_q', 'gen', 'gen_q', 'gen_prefix', 'gen_mixed',
-            'gen_spec', 'gen_kernel', 'gen_load', 'gen_tier', 'gen_chaos',
-            'gen_history', 'gen_kvq',
+            'gen_spec', 'gen_kernel', 'gen_load', 'gen_tier', 'gen_router',
+            'gen_chaos', 'gen_history', 'gen_kvq',
         ],
     )
     args = parser.parse_args()

@@ -150,8 +150,18 @@ class SwallowedExceptionRule(Rule):
     _SCOPE_PREFIXES = (
         'distllm_tpu/generate/engine/',
         'distllm_tpu/resilience/',
+        # Multi-replica serving tier (docs/routing.md): the router is a
+        # proxy on the request path — a swallowed proxy/probe error is a
+        # replica silently leaving (or wrongly staying in) rotation.
+        'distllm_tpu/router/',
     )
-    _SCOPE_FILES = ('distllm_tpu/chat_server.py',)
+    _SCOPE_FILES = (
+        'distllm_tpu/chat_server.py',
+        # Peer KV transport and the HTTP loadgen driver: both absorb
+        # network failures by design, so every absorb must be counted.
+        'distllm_tpu/parallel/fabric.py',
+        'distllm_tpu/generate/loadgen.py',
+    )
 
     # Attribute calls that count as telemetry. Generous on purpose: the
     # rule exists to surface handlers with NO signal at all, and a

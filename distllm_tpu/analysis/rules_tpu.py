@@ -511,6 +511,23 @@ class HostSyncInHotPathRule(Rule):
             'sample_tokens_windowed',
             'verify_spans',
         ),
+        # Peer KV handoff (docs/routing.md "Peer KV tier"): the tier walk
+        # and the fabric fetch/serve run inside the serving loop's
+        # promotion path (and, server-side, concurrent WITH a sibling's
+        # loop). All host/zmq/numpy work by design — a device sync added
+        # here would stall a replica on its PEER's traffic.
+        'distllm_tpu/generate/engine/kv_cache.py': (
+            'PeerKVTier.contains',
+            'PeerKVTier.get',
+            'HostKVTier.lookup',
+            'HostKVTier.get',
+            'HostKVTier.contains_local',
+            'HostKVTier.encoded_local',
+        ),
+        'distllm_tpu/parallel/fabric.py': (
+            'KVBlockServer._serve',
+            'KVBlockClient.request',
+        ),
     }
 
     _SYNC_CALLS = frozenset({'asarray', 'array', 'device_get'})

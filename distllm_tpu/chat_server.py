@@ -20,6 +20,9 @@ Observability surface (docs/observability.md):
   (engine throughput, KV occupancy, queue depth, HTTP latency, request
   TTFT/TPOT/queue-wait, ...);
 - ``GET /health`` — liveness plus uptime / in-flight / served counts;
+- ``GET /loadinfo`` — cheap JSON load probe for the multi-replica router
+  (queue depth, readiness, drain state, KV occupancy; docs/routing.md) —
+  per-app/per-engine state, never a Prometheus text parse;
 - ``GET /debug/traces?limit=N`` — most recent spans from the trace ring;
 - ``GET /debug/flight?limit=N`` — most recent engine flight-recorder
   records (prefill/decode steps, request lifecycles, preemptions);
@@ -61,6 +64,12 @@ and the engine's request lifecycle records carry it), and echoes it back
 both as the ``X-Request-Id`` response header and a ``request_id`` field in
 the completion payload.
 
+Multi-replica routing (docs/routing.md): every completion response
+carries ``X-Distllm-Prefix-Digest`` + ``X-Distllm-Prefix-Depth`` — the
+byte-level prefix digest chain the router's affinity maps learn replica
+cache residency from (``router/affinity.py``; same chained hashing the
+KV tiers key on).
+
 Generation requests run under an optional stall watchdog
 (``DISTLLM_WATCHDOG_S`` seconds, 0 = off): if the engine makes no
 progress for that long mid-request, a debug bundle is dumped
@@ -84,6 +93,11 @@ import uuid
 import distllm_tpu
 from distllm_tpu.chat import ChatAppConfig, ChatSession
 from distllm_tpu.resilience import EngineOverloaded
+from distllm_tpu.router.affinity import (
+    HEADER_DEPTH,
+    HEADER_DIGEST,
+    prompt_prefix_digests,
+)
 from distllm_tpu.observability import (
     HistorySampler,
     StallWatchdog,
@@ -162,7 +176,9 @@ def build_app(config: ChatAppConfig):
 
     # Known routes pre-register their latency/count series so the very
     # first /metrics scrape already carries the full schema.
-    known_paths = ('/v1/chat/completions', '/health', '/metrics', '/drain')
+    known_paths = (
+        '/v1/chat/completions', '/health', '/metrics', '/drain', '/loadinfo',
+    )
     for path in known_paths:
         instruments.HTTP_LATENCY.labels(path=path)
 
@@ -321,13 +337,24 @@ def build_app(config: ChatAppConfig):
             )
         finally:
             state['completions_in_flight'] -= 1
+        # Affinity-learning headers (docs/routing.md "Digest learning"):
+        # having served this request, the replica now holds its whole
+        # prompt prefix — advertise the deepest byte-chain digest + depth
+        # so the router's per-replica map learns where the blocks live.
+        # The router verifies the digest against its own chain before
+        # trusting the sample, so the header can never poison routing.
+        digest_headers = {'X-Request-Id': request_id}
+        chain = prompt_prefix_digests(messages)
+        if chain:
+            digest_headers[HEADER_DIGEST] = chain[-1].hex()
+            digest_headers[HEADER_DEPTH] = str(len(chain))
         if body.get('stream'):
             # Single-delta SSE streaming (reference ``chat_server.py:168-270``).
             response = web.StreamResponse(
                 headers={
                     'Content-Type': 'text/event-stream',
                     'Cache-Control': 'no-cache',
-                    'X-Request-Id': request_id,
+                    **digest_headers,
                 }
             )
             await response.prepare(request)
@@ -353,7 +380,7 @@ def build_app(config: ChatAppConfig):
             return response
         return web.json_response(
             _completion_payload(model, content, request_id),
-            headers={'X-Request-Id': request_id},
+            headers=digest_headers,
         )
 
     async def health(request: 'web.Request') -> 'web.Response':
@@ -420,6 +447,38 @@ def build_app(config: ChatAppConfig):
                 'draining': True,
                 'drained': remaining == 0,
                 'in_flight_remaining': remaining,
+            }
+        )
+
+    async def loadinfo(request: 'web.Request') -> 'web.Response':
+        """``GET /loadinfo`` — the router's hot-path load probe
+        (docs/routing.md "Least-loaded fallback"): queue depth,
+        readiness, drain state, and KV occupancy as a tiny JSON doc, so
+        the router never parses Prometheus text per routing decision.
+        ``/metrics`` stays unchanged for scrapes. Reads THIS app's drain
+        flag and THIS engine's scheduler — unlike the process-wide
+        gauges, correct even with several in-process replicas (the bench
+        topology). Always 200: a draining replica still answers, the
+        body says to route away."""
+        engine = getattr(session.generator, 'engine', None)
+        sched = getattr(engine, 'sched', None)
+        queue_depth = running = 0
+        kv_occupancy = 0.0
+        if sched is not None:
+            queue_depth = int(sched.num_waiting)
+            running = int(sched.num_running)
+            usable = max(1, int(engine.config.num_blocks) - 1)
+            in_use = max(0, usable - int(sched.num_free_blocks))
+            kv_occupancy = round(in_use / usable, 4)
+        draining = state['draining']
+        return web.json_response(
+            {
+                'ready': not draining,
+                'draining': draining,
+                'queue_depth': queue_depth,
+                'running': running,
+                'in_flight': int(state['completions_in_flight']),
+                'kv_occupancy': kv_occupancy,
             }
         )
 
@@ -599,6 +658,7 @@ def build_app(config: ChatAppConfig):
     app.router.add_get('/health', health)
     app.router.add_post('/drain', drain)
     app.router.add_get('/metrics', metrics)
+    app.router.add_get('/loadinfo', loadinfo)
     app.router.add_get('/debug/traces', traces)
     app.router.add_get('/debug/flight', flight)
     app.router.add_get('/debug/perfetto', perfetto)

@@ -44,6 +44,7 @@ from distllm_tpu.generate.engine.kv_cache import (
     DiskKVTier,
     HostKVTier,
     PagedKVCache,
+    PeerKVTier,
     PrefixCache,
     block_digests,
 )
@@ -282,7 +283,7 @@ class EngineConfig(BaseConfig):
     @field_validator(
         'sampling_top_window', 'prefill_chunk_tokens',
         'max_window_prefill_tokens', 'draft_k', 'host_kv_tier_bytes',
-        'disk_kv_tier_bytes', 'max_dispatch_retries',
+        'disk_kv_tier_bytes', 'max_dispatch_retries', 'peer_kv_timeout_ms',
     )
     @classmethod
     def _non_negative_window(cls, v: int, info) -> int:
@@ -291,7 +292,8 @@ class EngineConfig(BaseConfig):
         return v
 
     @field_validator(
-        'request_deadline_s', 'retry_backoff_s', 'history_interval_s'
+        'request_deadline_s', 'retry_backoff_s', 'history_interval_s',
+        'peer_kv_backoff_s',
     )
     @classmethod
     def _non_negative_seconds(cls, v: float, info) -> float:
@@ -371,6 +373,19 @@ class EngineConfig(BaseConfig):
                 'promotions route disk → host → device '
                 '(docs/prefix_caching.md "Tier hierarchy")'
             )
+        if self.peer_kv_endpoints is not None and not self.host_kv_tier_bytes:
+            raise ValueError(
+                'peer_kv_endpoints needs host_kv_tier_bytes > 0: peer '
+                'fetches land in the host pool and promote host → device '
+                'exactly like a disk hit (docs/routing.md "Peer KV tier")'
+            )
+        if self.peer_kv_serve_endpoint and not self.host_kv_tier_bytes:
+            raise ValueError(
+                'peer_kv_serve_endpoint needs host_kv_tier_bytes > 0: the '
+                'KVBlockServer answers HAS/GET from the host/disk pools — '
+                'without a host tier there is nothing to serve '
+                '(docs/routing.md "Peer KV tier")'
+            )
         if self.admission_control and self.ttft_slo_s <= 0:
             raise ValueError(
                 'admission_control needs ttft_slo_s > 0: shedding is '
@@ -402,6 +417,25 @@ class EngineConfig(BaseConfig):
     disk_kv_tier_dir: str | None = None
     # Disk-tier byte budget (LRU; evictions there are final drops).
     disk_kv_tier_bytes: int = 1 << 30
+    # Peer KV tier (docs/routing.md "Peer KV tier"): sibling replicas'
+    # KVBlockServer endpoints ('tcp://host:port') to consult AFTER host
+    # and disk miss — a replica adopts a peer's spilled .kvblock payloads
+    # through the same async promotion path as a disk hit, the
+    # content-addressed KV-handoff seed of prefill/decode disaggregation.
+    # None disables the tier entirely; an empty tuple enables it with no
+    # peers yet (endpoints can be added at runtime via
+    # engine.kv_tier.peer.add_endpoint). Requires host_kv_tier_bytes > 0.
+    peer_kv_endpoints: tuple[str, ...] | None = None
+    # Serve THIS replica's spilled blocks to peers: a zmq bind spec for
+    # the KVBlockServer ('tcp://127.0.0.1:0' picks a free port; the
+    # resolved endpoint is exposed as engine.peer_kv_endpoint). None
+    # disables serving. Requires host_kv_tier_bytes > 0.
+    peer_kv_serve_endpoint: str | None = None
+    # Per-request timeout for one peer HAS/GET round trip, and the
+    # cool-off a failing endpoint sits out before being consulted again
+    # (fetch failure degrades to cold prefill, never blocks serving).
+    peer_kv_timeout_ms: int = 500
+    peer_kv_backoff_s: float = 5.0
     # Split uncached prefill tails longer than this many tokens into
     # bucketed chunks dispatched sequentially (each chunk attends to the
     # KV already in the paged cache), so one long prompt cannot
@@ -849,18 +883,43 @@ class LLMEngine:
         self.prefix_cache = (
             PrefixCache(cfg.block_size) if cfg.enable_prefix_cache else None
         )
-        # Host-RAM (and disk) KV tier behind the prefix cache
+        # Host-RAM (and disk, and peer) KV tier behind the prefix cache
         # (docs/prefix_caching.md "Tier hierarchy"): eviction pressure
-        # cascades HBM → host → disk → drop, and host/disk hits promote
-        # back into the paged pool via async device_put at admission.
+        # cascades HBM → host → disk → peer → drop, and host/disk/peer
+        # hits promote back into the paged pool via async device_put at
+        # admission. The peer hop (docs/routing.md) consults sibling
+        # replicas' KVBlockServers after a local miss; this replica's own
+        # spills are served back when peer_kv_serve_endpoint is set.
         self.kv_tier = None
+        self.peer_kv_endpoint: str | None = None
+        self._peer_kv_server = None
         if cfg.host_kv_tier_bytes:
             disk = (
                 DiskKVTier(cfg.disk_kv_tier_dir, cfg.disk_kv_tier_bytes)
                 if cfg.disk_kv_tier_dir
                 else None
             )
-            self.kv_tier = HostKVTier(cfg.host_kv_tier_bytes, disk=disk)
+            peer = (
+                PeerKVTier(
+                    cfg.peer_kv_endpoints,
+                    timeout_ms=cfg.peer_kv_timeout_ms,
+                    failure_backoff_s=cfg.peer_kv_backoff_s,
+                )
+                if cfg.peer_kv_endpoints is not None
+                else None
+            )
+            self.kv_tier = HostKVTier(
+                cfg.host_kv_tier_bytes, disk=disk, peer=peer
+            )
+            if cfg.peer_kv_serve_endpoint:
+                from distllm_tpu.parallel.fabric import KVBlockServer
+
+                self._peer_kv_server = KVBlockServer(
+                    self.kv_tier.contains_local,
+                    self.kv_tier.encoded_local,
+                    bind=cfg.peer_kv_serve_endpoint,
+                ).start()
+                self.peer_kv_endpoint = self._peer_kv_server.endpoint
         # In-flight promotions: rid -> completion record ({'token': a tiny
         # post-scatter device slice whose readiness proves the promoted
         # KV landed, timing fields}). The request stays non-decode-ready
@@ -2449,6 +2508,12 @@ class LLMEngine:
         if self.kv_tier.disk is not None:
             out['disk_blocks'] = self.kv_tier.disk.num_blocks
             out['disk_bytes'] = self.kv_tier.disk.bytes_used
+        if self.kv_tier.peer is not None:
+            out['peer_fetched_blocks'] = self.kv_tier.peer.fetched_blocks
+            out['peer_fetched_bytes'] = self.kv_tier.peer.fetched_bytes
+        if self._peer_kv_server is not None:
+            out['peer_served_blocks'] = self._peer_kv_server.served_blocks
+            out['peer_served_bytes'] = self._peer_kv_server.served_bytes
         return out
 
     def _prefill_batch_cap(self, bucket: int) -> int:
@@ -4377,6 +4442,11 @@ class LLMEngine:
         if self._history_sampler is not None:
             self._history_sampler.stop()
             self._history_sampler = None
+        if self._peer_kv_server is not None:
+            self._peer_kv_server.close()
+            self._peer_kv_server = None
+        if self.kv_tier is not None and self.kv_tier.peer is not None:
+            self.kv_tier.peer.close()
         self.params = None
         self.kv = None
 

@@ -16,13 +16,18 @@ eviction of unreferenced blocks. It owns the REUSE policy only — physical
 block accounting stays with the scheduler, which marks cache-held blocks
 as a request's "borrowed prefix" (``scheduler.py``).
 
-:class:`HostKVTier` and :class:`DiskKVTier` extend the cache past HBM
-(docs/prefix_caching.md "Tier hierarchy"): eviction cascades
+:class:`HostKVTier`, :class:`DiskKVTier`, and :class:`PeerKVTier` extend
+the cache past HBM (docs/prefix_caching.md "Tier hierarchy",
+docs/routing.md "Peer KV tier"): eviction cascades
 HBM → host-RAM → disk → drop instead of dropping KV at the first tier,
 and the engine promotes tier hits back into the paged pool via async
-``device_put`` overlapped with decode windows. Both tiers are pure host
-pools keyed by the same chained digests; the disk tier's digest-named
-files persist warm prefixes across engine restarts.
+``device_put`` overlapped with decode windows. Lookup falls through
+host → disk → **peer**: a replica that misses locally can adopt a
+sibling replica's spilled blocks over the zmq fabric
+(``parallel/fabric.py``) exactly like a disk promotion. All tiers are
+keyed by the same chained digests and exchange the same ``.kvblock`` v2
+payload (:func:`encode_kvblock` / :func:`decode_kvblock`); the disk
+tier's digest-named files persist warm prefixes across engine restarts.
 
 Mixed serving windows (docs/serving.md) write prefill-chunk K/V inside
 decode dispatches; those writes always land in blocks the owning request
@@ -39,6 +44,7 @@ import hashlib
 import json
 import os
 import threading
+import time
 from collections import OrderedDict
 from pathlib import Path
 from dataclasses import dataclass, field
@@ -334,6 +340,87 @@ class PrefixCache:
         _m.PREFIX_SHARED_BLOCKS.set(self.num_shared)
 
 
+def encode_kvblock(
+    k: np.ndarray,
+    v: np.ndarray,
+    k_scale: np.ndarray | None = None,
+    v_scale: np.ndarray | None = None,
+) -> bytes:
+    """Serialize one block's KV (plus int8 scale rows) as ``.kvblock`` v2.
+
+    One JSON header line carrying shape/dtype (and the optional scales
+    entry), then the raw K bytes followed by the raw V bytes (then
+    K-scale, V-scale) at exact byte offsets — byte-exact for bf16 and
+    every other KV dtype, no pickle. The SAME payload serves as the disk
+    tier's file format and the peer tier's wire format: a sibling
+    replica's fetch and a process restart read identical bytes."""
+    meta = {'version': 2, 'shape': list(k.shape), 'dtype': str(k.dtype)}
+    meta['scales'] = (
+        None if k_scale is None
+        else {'shape': list(k_scale.shape), 'dtype': str(k_scale.dtype)}
+    )
+    # Compact separators: the header rides every spilled block.
+    header = json.dumps(meta, separators=(',', ':')).encode() + b'\n'
+    payload = header + k.tobytes() + v.tobytes()
+    if k_scale is not None:
+        payload += k_scale.tobytes() + v_scale.tobytes()
+    return payload
+
+
+def decode_kvblock(payload: bytes) -> tuple[np.ndarray, ...]:
+    """Parse a ``.kvblock`` payload back into ``(K, V)`` — or ``(K, V,
+    K_scale, V_scale)`` for a quantized spill.
+
+    Raises ``ValueError``/``KeyError``/``TypeError`` on corruption (bad
+    header, short read, trailing bytes, unknown version): callers — the
+    disk tier's file read, the peer tier's fabric fetch — must degrade
+    the failure to a counted tier error + miss, never let it reach
+    ``add_request``."""
+    header, sep, body = payload.partition(b'\n')
+    if not sep:
+        raise ValueError('missing header line')
+    meta = json.loads(header)
+    version = int(meta.get('version', 1))
+    if version > 2:
+        # A newer process wrote a layout this reader does not
+        # understand; halving the body blindly would hand the
+        # attention kernel another format's bytes as KV.
+        raise ValueError(f'unknown .kvblock version {version}')
+    # jnp.dtype resolves 'bfloat16' through ml_dtypes into a
+    # numpy-compatible dtype, so the round trip is byte-exact for
+    # bf16 KV.
+    dtype = np.dtype(jnp.dtype(meta['dtype']))
+    shape = tuple(int(d) for d in meta['shape'])
+    if version < 2:
+        # Version-less pre-int8 spill: body is exactly K then V.
+        half = len(body) // 2
+        k = np.frombuffer(body[:half], dtype=dtype).reshape(shape)
+        v = np.frombuffer(body[half:], dtype=dtype).reshape(shape)
+        return k, v
+    # v2: exact byte offsets from the header (never len//2 — the
+    # optional scale tail would skew the split).
+    scales_meta = meta.get('scales')
+    arrays: list[np.ndarray] = []
+    offset = 0
+    specs = [(shape, dtype), (shape, dtype)]
+    if scales_meta is not None:
+        s_dtype = np.dtype(jnp.dtype(scales_meta['dtype']))
+        s_shape = tuple(int(d) for d in scales_meta['shape'])
+        specs += [(s_shape, s_dtype), (s_shape, s_dtype)]
+    for a_shape, a_dtype in specs:
+        count = int(np.prod(a_shape)) * a_dtype.itemsize
+        chunk = body[offset:offset + count]
+        if len(chunk) != count:
+            raise ValueError('truncated .kvblock body')
+        arrays.append(
+            np.frombuffer(chunk, dtype=a_dtype).reshape(a_shape)
+        )
+        offset += count
+    if offset != len(body):
+        raise ValueError('trailing bytes in .kvblock body')
+    return tuple(arrays)
+
+
 class DiskKVTier:
     """Digest-keyed KV block files: the persistence tier under the host
     pool (docs/prefix_caching.md "Tier hierarchy").
@@ -458,16 +545,7 @@ class DiskKVTier:
         from distllm_tpu.resilience.faults import get_fault_injector
 
         hexdigest = digest.hex()
-        meta = {'version': 2, 'shape': list(k.shape), 'dtype': str(k.dtype)}
-        meta['scales'] = (
-            None if k_scale is None
-            else {'shape': list(k_scale.shape), 'dtype': str(k_scale.dtype)}
-        )
-        # Compact separators: the header rides every spilled block.
-        header = json.dumps(meta, separators=(',', ':')).encode() + b'\n'
-        payload = header + k.tobytes() + v.tobytes()
-        if k_scale is not None:
-            payload += k_scale.tobytes() + v_scale.tobytes()
+        payload = encode_kvblock(k, v, k_scale, v_scale)
         with self._lock:
             if hexdigest in self._index:
                 self._index.move_to_end(hexdigest)
@@ -521,49 +599,7 @@ class DiskKVTier:
             self._drop_entry(hexdigest)
             return None
         try:
-            header, sep, body = payload.partition(b'\n')
-            if not sep:
-                raise ValueError('missing header line')
-            meta = json.loads(header)
-            version = int(meta.get('version', 1))
-            if version > 2:
-                # A newer process wrote a layout this reader does not
-                # understand; halving the body blindly would hand the
-                # attention kernel another format's bytes as KV.
-                raise ValueError(f'unknown .kvblock version {version}')
-            # jnp.dtype resolves 'bfloat16' through ml_dtypes into a
-            # numpy-compatible dtype, so the round trip is byte-exact for
-            # bf16 KV.
-            dtype = np.dtype(jnp.dtype(meta['dtype']))
-            shape = tuple(int(d) for d in meta['shape'])
-            if version < 2:
-                # Version-less pre-int8 spill: body is exactly K then V.
-                half = len(body) // 2
-                k = np.frombuffer(body[:half], dtype=dtype).reshape(shape)
-                v = np.frombuffer(body[half:], dtype=dtype).reshape(shape)
-                return k, v
-            # v2: exact byte offsets from the header (never len//2 — the
-            # optional scale tail would skew the split).
-            scales_meta = meta.get('scales')
-            arrays: list[np.ndarray] = []
-            offset = 0
-            specs = [(shape, dtype), (shape, dtype)]
-            if scales_meta is not None:
-                s_dtype = np.dtype(jnp.dtype(scales_meta['dtype']))
-                s_shape = tuple(int(d) for d in scales_meta['shape'])
-                specs += [(s_shape, s_dtype), (s_shape, s_dtype)]
-            for a_shape, a_dtype in specs:
-                count = int(np.prod(a_shape)) * a_dtype.itemsize
-                chunk = body[offset:offset + count]
-                if len(chunk) != count:
-                    raise ValueError('truncated .kvblock body')
-                arrays.append(
-                    np.frombuffer(chunk, dtype=a_dtype).reshape(a_shape)
-                )
-                offset += count
-            if offset != len(body):
-                raise ValueError('trailing bytes in .kvblock body')
-            return tuple(arrays)
+            return decode_kvblock(payload)
         # distlint: disable=swallowed-exception -- degradation is counted: _drop_entry increments distllm_prefix_tier_errors_total{tier="disk"} and unlinks the corrupt file
         except (ValueError, KeyError, TypeError):
             self._drop_entry(hexdigest, unlink=True)
@@ -580,6 +616,142 @@ class DiskKVTier:
             return self._bytes
 
 
+class PeerKVTier:
+    """Sibling replicas' spilled KV blocks, fetched over the zmq fabric —
+    the tier between disk and drop (docs/routing.md "Peer KV tier").
+
+    Each peer endpoint is a sibling replica's
+    :class:`~distllm_tpu.parallel.fabric.KVBlockServer`, answering
+    digest-keyed HAS/GET with the same ``.kvblock`` v2 payload the disk
+    tier persists (:func:`encode_kvblock`): content-addressed KV handoff,
+    no new wire format. Purely a READ tier — spills never write here
+    (each replica owns its own spill budget); a fetched block re-enters
+    the local host pool like a disk promotion. Every failure degrades:
+    an unreachable peer backs off ``failure_backoff_s`` and the lookup
+    misses (cold prefill), a corrupt payload counts
+    ``distllm_prefix_tier_errors_total{tier="peer"}`` — the serving loop
+    never sees a network exception. Endpoints may be added after
+    construction (``add_endpoint``): sibling ports are usually unknown
+    until every replica has bound its serve socket.
+    """
+
+    def __init__(
+        self,
+        endpoints: Sequence[str] = (),
+        *,
+        timeout_ms: int = 500,
+        failure_backoff_s: float = 5.0,
+    ) -> None:
+        # Lazy fabric import: kv_cache must stay importable without zmq
+        # reaching module scope (mirrors the tiers' lazy instruments).
+        from distllm_tpu.parallel.fabric import KVBlockClient
+
+        self._lock = threading.Lock()
+        self.endpoints: list[str] = list(endpoints)  # guarded by self._lock
+        self.failure_backoff_s = float(failure_backoff_s)
+        self._client = KVBlockClient(timeout_ms=timeout_ms)
+        # endpoint -> monotonic instant its backoff expires.
+        self._backoff_until: dict[str, float] = {}  # guarded by self._lock
+        # Tiny digest -> endpoint memo so get() asks the peer contains()
+        # just saw first, instead of re-probing every sibling.
+        self._hit_memo: 'OrderedDict[bytes, str]' = OrderedDict()  # guarded by self._lock
+        self.fetched_blocks = 0
+        self.fetched_bytes = 0
+
+    def add_endpoint(self, endpoint: str) -> None:
+        with self._lock:
+            if endpoint not in self.endpoints:
+                self.endpoints.append(endpoint)
+
+    def _live_endpoints(self) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                ep for ep in self.endpoints
+                if self._backoff_until.get(ep, 0.0) <= now
+            ]
+
+    def _note_failure(self, endpoint: str) -> None:
+        from distllm_tpu.observability import instruments as _m
+
+        _m.PREFIX_TIER_ERRORS.labels(tier='peer').inc()
+        with self._lock:
+            self._backoff_until[endpoint] = (
+                time.monotonic() + self.failure_backoff_s
+            )
+
+    def _memo(self, digest: bytes, endpoint: str) -> None:
+        with self._lock:
+            self._hit_memo[digest] = endpoint
+            self._hit_memo.move_to_end(digest)
+            while len(self._hit_memo) > 1024:
+                self._hit_memo.popitem(last=False)
+
+    def contains(self, digest: bytes) -> bool:
+        """Membership across live peers (first hit wins, memoized for the
+        ``get`` that follows). Network probes on the admission path are
+        bounded by the client timeout and the per-peer backoff."""
+        from distllm_tpu.parallel.fabric import KV_HIT
+
+        for endpoint in self._live_endpoints():
+            reply = self._client.request(endpoint, b'HAS', digest)
+            if reply is None:
+                self._note_failure(endpoint)
+                continue
+            if reply[0] == KV_HIT:
+                self._memo(digest, endpoint)
+                return True
+        return False
+
+    def get(self, digest: bytes) -> tuple[np.ndarray, ...] | None:
+        """Fetch one block's host arrays from a sibling replica, memoized
+        endpoint first. A hit lands a ``peer_fetch`` flight record (the
+        fabric twin of the promotion path's ``promote``); every failure
+        mode — timeout, MISS, corrupt payload — returns None so the
+        caller degrades to cold prefill."""
+        from distllm_tpu.observability import instruments as _m
+        from distllm_tpu.observability.flight import get_flight_recorder
+        from distllm_tpu.parallel.fabric import KV_HIT
+
+        with self._lock:
+            memo = self._hit_memo.get(digest)
+        ordered = self._live_endpoints()
+        if memo in ordered:
+            ordered.remove(memo)
+            ordered.insert(0, memo)
+        for endpoint in ordered:
+            t_start = time.monotonic()
+            reply = self._client.request(endpoint, b'GET', digest)
+            if reply is None:
+                self._note_failure(endpoint)
+                continue
+            status, payload = reply
+            if status != KV_HIT:
+                continue  # evicted on the sibling since the HAS probe
+            try:
+                arrays = decode_kvblock(payload)
+            except (ValueError, KeyError, TypeError):
+                # Counted degradation; the caller falls through to cold
+                # prefill (docs/routing.md "Peer KV tier").
+                _m.PREFIX_TIER_ERRORS.labels(tier='peer').inc()
+                continue
+            fetch_s = time.monotonic() - t_start
+            self.fetched_blocks += 1
+            self.fetched_bytes += len(payload)
+            get_flight_recorder().record(
+                'peer_fetch',
+                endpoint=endpoint,
+                blocks=1,
+                bytes=len(payload),
+                fetch_s=round(fetch_s, 6),
+            )
+            return arrays
+        return None
+
+    def close(self) -> None:
+        self._client.close()
+
+
 class HostKVTier:
     """Bounded digest-keyed host-RAM pool of spilled KV blocks — the tier
     between the HBM prefix cache and the (optional) disk tier.
@@ -593,14 +765,23 @@ class HostKVTier:
     by the chained block digest, LRU-ordered, bounded by ``max_bytes``.
     With a :class:`DiskKVTier` attached, spills write THROUGH to disk
     (persistence never depends on host-LRU timing) and host misses fall
-    through to disk, pulling hits back into the host pool. Thread-safe
-    for the same reason as the disk tier.
+    through to disk, pulling hits back into the host pool. With a
+    :class:`PeerKVTier` attached, the fallthrough extends one hop
+    further — host → disk → peer — and a peer hit re-enters the host
+    pool the same way (docs/routing.md). Thread-safe for the same reason
+    as the disk tier.
     """
 
-    def __init__(self, max_bytes: int, disk: DiskKVTier | None = None) -> None:
+    def __init__(
+        self,
+        max_bytes: int,
+        disk: DiskKVTier | None = None,
+        peer: 'PeerKVTier | None' = None,
+    ) -> None:
         self._lock = threading.Lock()
         self.max_bytes = int(max_bytes)
         self.disk = disk
+        self.peer = peer
         # digest -> (k, v[, k_scale, v_scale]) host arrays, LRU order
         # (oldest first). Arity follows what was spilled: the tier never
         # inspects payloads beyond byte accounting.
@@ -630,9 +811,11 @@ class HostKVTier:
                 _m.PREFIX_TIER_DROPPED_BLOCKS.inc()
 
     def lookup(self, digest: bytes) -> str | None:
-        """Which tier holds ``digest`` (``'host'``/``'disk'``/None), with
-        hit/miss accounting. Pure membership — no load, no LRU touch —
-        so ``add_request``'s promotion-planning walk stays cheap."""
+        """Which tier holds ``digest``
+        (``'host'``/``'disk'``/``'peer'``/None), with hit/miss
+        accounting. Pure membership — no load, no LRU touch — so
+        ``add_request``'s promotion-planning walk stays cheap (the peer
+        hop is a bounded-timeout fabric probe, consulted last)."""
         from distllm_tpu.observability import instruments as _m
 
         with self._lock:
@@ -642,8 +825,36 @@ class HostKVTier:
         if self.disk is not None and self.disk.contains(digest):
             _m.PREFIX_TIER_HITS.labels(tier='disk').inc()
             return 'disk'
-        _m.PREFIX_TIER_MISSES.labels(tier='disk' if self.disk else 'host').inc()
+        if self.peer is not None and self.peer.contains(digest):
+            _m.PREFIX_TIER_HITS.labels(tier='peer').inc()
+            return 'peer'
+        lowest = (
+            'peer' if self.peer is not None
+            else 'disk' if self.disk is not None
+            else 'host'
+        )
+        _m.PREFIX_TIER_MISSES.labels(tier=lowest).inc()
         return None
+
+    def contains_local(self, digest: bytes) -> bool:
+        """Metric-free host/disk membership — the KVBlockServer's HAS
+        answer. A sibling's probe must not skew THIS replica's tier
+        hit/miss accounting, and must never recurse into this replica's
+        own peer tier (two replicas would ping-pong a miss forever)."""
+        with self._lock:
+            if digest in self._entries:
+                return True
+        return self.disk is not None and self.disk.contains(digest)
+
+    def encoded_local(self, digest: bytes) -> bytes | None:
+        """One block as ``.kvblock`` payload from the LOCAL host/disk
+        tiers only — the KVBlockServer's GET answer (serve side of the
+        peer hop; peer recursion excluded for the same reason as
+        ``contains_local``)."""
+        arrays = self.get(digest, allow_peer=False)
+        if arrays is None:
+            return None
+        return encode_kvblock(*arrays)
 
     def put(
         self,
@@ -673,24 +884,33 @@ class HostKVTier:
             self._publish_locked()
         return True
 
-    def get(self, digest: bytes) -> tuple[np.ndarray, ...] | None:
+    def get(
+        self, digest: bytes, *, allow_peer: bool = True
+    ) -> tuple[np.ndarray, ...] | None:
         """``(K, V)`` — or ``(K, V, K_scale, V_scale)`` for a quantized
         spill — for ``digest``, refreshing its LRU slot; host misses fall
-        through to the disk tier, and a disk hit re-enters the host pool
-        (a promoted prefix is about to be hot again)."""
+        through to the disk tier, then (``allow_peer``) to the peer tier,
+        and a lower-tier hit re-enters the host pool (a promoted prefix
+        is about to be hot again)."""
         with self._lock:
             entry = self._entries.get(digest)
             if entry is not None:
                 self._entries.move_to_end(digest)
                 return entry
-        if self.disk is None:
-            return None
-        loaded = self.disk.get(digest)
+        loaded = source = None
+        if self.disk is not None:
+            loaded = self.disk.get(digest)
+            if loaded is not None:
+                source = 'disk'
+        if loaded is None and allow_peer and self.peer is not None:
+            loaded = self.peer.get(digest)
+            if loaded is not None:
+                source = 'peer'
         if loaded is None:
             return None
         from distllm_tpu.observability import instruments as _m
 
-        _m.PREFIX_TIER_PROMOTIONS.labels(tier='disk').inc()
+        _m.PREFIX_TIER_PROMOTIONS.labels(tier=source).inc()
         with self._lock:
             if digest not in self._entries:
                 self._entries[digest] = loaded

@@ -23,13 +23,25 @@ SLO-scheduling roadmap items build on:
   flight ring), warm-prefix hit counts, and the per-window-kind
   MFU / bandwidth-utilization summary.
 
-Used by the ``gen_load`` bench stage (``DISTLLM_BENCH_LOAD=0`` skips) and
-the ``scripts/loadgen.py`` CLI; knobs documented in
-``docs/observability.md``.
+A second driver, :func:`run_http_loadgen`, replays the SAME workload
+against an OpenAI-compatible HTTP endpoint (one chat_server, or the
+multi-replica router — docs/routing.md) instead of an in-process engine:
+prompt token ids render to a deterministic text form
+(:func:`arrival_messages`), arrivals fire on the open-loop schedule from
+an asyncio loop, and TTFT is measured from the SCHEDULED arrival instant
+(never the actual send) — the same coordinated-omission correction the
+in-process driver applies to ``t_enqueue``.
+
+Used by the ``gen_load`` / ``gen_router`` bench stages
+(``DISTLLM_BENCH_LOAD=0`` / ``DISTLLM_BENCH_ROUTER=0`` skip) and the
+``scripts/loadgen.py`` CLI (``--endpoint http://...`` selects the HTTP
+mode); knobs documented in ``docs/observability.md``.
 """
 
 from __future__ import annotations
 
+import asyncio
+import json
 import time
 from dataclasses import dataclass, field
 
@@ -230,6 +242,181 @@ def _exact_percentiles(values: list[float]) -> dict[str, float | None]:
     }
 
 
+def arrival_messages(arrival: Arrival) -> list[dict]:
+    """Deterministic OpenAI message rendering of one arrival's prompt.
+
+    Space-joined decimal token ids as a single user message: two arrivals
+    sharing a token-id prefix share a byte prefix of the rendered content
+    — exactly what the router's byte-level digest chain needs to see the
+    same warm/cold structure the in-process driver exercises."""
+    return [
+        {
+            'role': 'user',
+            'content': ' '.join(str(t) for t in arrival.prompt_ids),
+        }
+    ]
+
+
+@dataclass
+class HttpLoadReport:
+    """What one HTTP loadgen run measured. Per-arrival lists align with
+    the sorted schedule (like ``LoadReport.ttft_by_request``); replica
+    attribution comes from the ``X-Distllm-Router-Replica`` header when
+    the endpoint is the router (empty dict against a bare chat_server).
+    """
+
+    requests: int
+    ok: int
+    rejected: int       # 429 admission rejections (propagated untouched)
+    retried: int        # responses carrying X-Distllm-Router-Retry
+    errors: int         # transport failures / 5xx
+    elapsed_s: float
+    goodput_rps: float  # SLO-met ok requests (all ok if no SLO) / elapsed
+    percentiles: dict[str, float | None]
+    by_replica: dict[str, int]
+    ttft_by_request: list
+    statuses: list
+    contents: list
+
+    def to_fragment(self, prefix: str) -> dict:
+        out = {
+            f'{prefix}requests': self.requests,
+            f'{prefix}ok': self.ok,
+            f'{prefix}rejected': self.rejected,
+            f'{prefix}retried': self.retried,
+            f'{prefix}errors': self.errors,
+            f'{prefix}elapsed_s': round(self.elapsed_s, 3),
+            f'{prefix}goodput_rps': round(self.goodput_rps, 3),
+            f'{prefix}replicas_used': len(self.by_replica),
+        }
+        for key, value in self.percentiles.items():
+            out[f'{prefix}{key}'] = (
+                round(value, 6) if value is not None else None
+            )
+        return out
+
+
+async def _run_http_async(
+    endpoint: str,
+    workload: list[Arrival],
+    *,
+    slo_s: float,
+    timeout_s: float,
+    stream: bool,
+) -> HttpLoadReport:
+    import aiohttp
+
+    schedule = sorted(workload, key=lambda a: a.at_s)
+    url = endpoint.rstrip('/') + '/v1/chat/completions'
+    n = len(schedule)
+    ttfts: list = [None] * n
+    statuses: list = [None] * n
+    contents: list = [None] * n
+    replicas: list = [None] * n
+    retried_flags = [False] * n
+    t0 = time.monotonic()
+
+    async def fire(i: int, arrival: Arrival, session) -> None:
+        delay = (t0 + arrival.at_s) - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        scheduled_at = t0 + arrival.at_s
+        body = {
+            'messages': arrival_messages(arrival),
+            'max_tokens': arrival.max_tokens,
+            'temperature': arrival.temperature,
+            'top_p': arrival.top_p,
+            'stream': stream,
+        }
+        try:
+            async with session.post(url, json=body) as resp:
+                # First payload byte stamps TTFT against the SCHEDULED
+                # arrival — a send delayed by a slow event loop must not
+                # hide queueing (coordinated-omission correction, the
+                # HTTP twin of the in-process t_enqueue re-anchor).
+                first = await resp.content.readany()
+                ttfts[i] = time.monotonic() - scheduled_at
+                payload = first + await resp.content.read()
+                statuses[i] = resp.status
+                replicas[i] = resp.headers.get('X-Distllm-Router-Replica')
+                retried_flags[i] = bool(
+                    resp.headers.get('X-Distllm-Router-Retry')
+                )
+                if resp.status == 200 and not stream:
+                    try:
+                        doc = json.loads(payload)
+                        contents[i] = doc['choices'][0]['message']['content']
+                    # distlint: disable=swallowed-exception -- a 200 with an unparseable body is counted below as an error status for the report; the raw status is the signal
+                    except (ValueError, KeyError, IndexError):
+                        statuses[i] = -1
+                elif resp.status == 200:
+                    contents[i] = payload.decode('utf-8', 'replace')
+        # distlint: disable=swallowed-exception -- a transport failure IS a datapoint in an open-loop run (the errors count + None status); raising would abort the schedule mid-flight
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            statuses[i] = None
+
+    timeout = aiohttp.ClientTimeout(total=timeout_s)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        await asyncio.gather(
+            *(fire(i, a, session) for i, a in enumerate(schedule))
+        )
+    elapsed_s = time.monotonic() - t0
+
+    ok_indices = [i for i, s in enumerate(statuses) if s == 200]
+    ok_ttfts = [ttfts[i] for i in ok_indices if ttfts[i] is not None]
+    met = [
+        t for t in ok_ttfts if slo_s <= 0 or t <= slo_s
+    ]
+    percentiles = {
+        f'ttft_{k}': v for k, v in _exact_percentiles(ok_ttfts).items()
+    }
+    by_replica: dict[str, int] = {}
+    for i in ok_indices:
+        if replicas[i]:
+            by_replica[replicas[i]] = by_replica.get(replicas[i], 0) + 1
+    return HttpLoadReport(
+        requests=n,
+        ok=len(ok_indices),
+        rejected=sum(1 for s in statuses if s == 429),
+        retried=sum(retried_flags),
+        errors=sum(
+            1 for s in statuses
+            if s is None or s == -1 or (isinstance(s, int) and s >= 500)
+        ),
+        elapsed_s=elapsed_s,
+        goodput_rps=len(met) / elapsed_s if elapsed_s > 0 else 0.0,
+        percentiles=percentiles,
+        by_replica=by_replica,
+        ttft_by_request=[
+            round(t, 6) if t is not None else None for t in ttfts
+        ],
+        statuses=statuses,
+        contents=contents,
+    )
+
+
+def run_http_loadgen(
+    endpoint: str,
+    workload: list[Arrival],
+    *,
+    slo_s: float = 0.0,
+    timeout_s: float = 120.0,
+    stream: bool = False,
+) -> HttpLoadReport:
+    """Replay ``workload`` open-loop against an OpenAI-compatible HTTP
+    endpoint (chat_server or the router). Blocking facade over the
+    asyncio driver — call from synchronous code (CLI, bench stages)."""
+    return asyncio.run(
+        _run_http_async(
+            endpoint,
+            workload,
+            slo_s=slo_s,
+            timeout_s=timeout_s,
+            stream=stream,
+        )
+    )
+
+
 def run_loadgen(
     engine, workload: list[Arrival], *, poll_sleep_s: float = 0.005
 ) -> LoadReport:
@@ -278,6 +465,7 @@ def run_loadgen(
                         max_tokens=arrival.max_tokens,
                     ),
                 )
+            # distlint: disable=swallowed-exception -- honest backpressure, already counted at the source: the engine recorded the 'shed' flight record + metric before raising
             except EngineOverloaded:
                 # SLO-aware admission control refused the arrival —
                 # honest backpressure, counted (the engine already

@@ -162,19 +162,54 @@ def load_host_capture(path: str | Path) -> tuple[list[dict], list[dict]]:
     return flight, spans
 
 
+def host_label(path: str | Path, seen: 'set[str] | None' = None) -> str:
+    """Readable per-process label for one capture file.
+
+    Multi-replica captures conventionally land as
+    ``<replica-id>/flight.jsonl`` (the bench) or
+    ``capture-<host>.jsonl`` — a bare ``Path(path).name`` collapses the
+    former to N identical ``flight.jsonl`` process groups, which is
+    exactly the unreadable-merge bug this fixes. Generic stems
+    (``flight``, ``spans``, ``capture``, ``trace``) take their parent
+    directory as the host/replica id; distinctive stems keep it. A
+    label already in ``seen`` gets the stem appended, then an index —
+    every input must stay distinguishable in the merged trace.
+    """
+    p = Path(path)
+    stem = p.stem
+    generic = stem.lower() in ('flight', 'spans', 'capture', 'trace', 'log')
+    label = (
+        p.parent.name if generic and p.parent.name not in ('', '.') else stem
+    )
+    if seen is None:
+        return label
+    if label in seen and label != stem:
+        label = f'{label}/{stem}'
+    base, n = label, 2
+    while label in seen:
+        label = f'{base}#{n}'
+        n += 1
+    seen.add(label)
+    return label
+
+
 def write_combined_perfetto(
     paths: list[str | Path], out: str | Path
 ) -> int:
     """Merge every input's flight/span JSONL records into one Perfetto
     trace (a process group per input file, shared time origin); returns
-    how many inputs contributed renderable records."""
+    how many inputs contributed renderable records. Process groups are
+    named by :func:`host_label` (host/replica id parsed from the capture
+    path), so a 3-replica merge reads ``replica-0 / replica-1 /
+    replica-2``, not three ``flight.jsonl``."""
     from distllm_tpu.observability.perfetto import merge_host_traces
 
     hosts = []
+    seen: set[str] = set()
     for path in paths:
         flight, spans = load_host_capture(path)
         if flight or spans:
-            hosts.append((Path(path).name, flight, spans))
+            hosts.append((host_label(path, seen), flight, spans))
     doc = merge_host_traces(hosts)
     Path(out).write_text(json.dumps(doc))
     return len(hosts)

@@ -149,10 +149,11 @@ PREFIX_COW_COPIES = _registry.counter(
 )
 
 # --------------------------------------------- prefix-cache tier hierarchy
-# HBM -> host-RAM -> disk spill/promote tiers (EngineConfig.
-# host_kv_tier_bytes / disk_kv_tier_dir; docs/prefix_caching.md "Tier
-# hierarchy"). Label values are the fixed TIER_LABELS below.
-TIER_LABELS = ('hbm', 'host', 'disk')
+# HBM -> host-RAM -> disk -> peer spill/promote tiers (EngineConfig.
+# host_kv_tier_bytes / disk_kv_tier_dir / peer_kv_endpoints;
+# docs/prefix_caching.md "Tier hierarchy", docs/routing.md "Peer KV
+# tier"). Label values are the fixed TIER_LABELS below.
+TIER_LABELS = ('hbm', 'host', 'disk', 'peer')
 PREFIX_TIER_HITS = _registry.counter(
     'distllm_prefix_tier_hits_total',
     'Prefix-cache block lookups served per tier: hbm = live paged-pool '
@@ -205,7 +206,9 @@ PREFIX_TIER_ERRORS = _registry.counter(
     'the serving path: disk = unreadable/corrupt/truncated .kvblock '
     'files or write IO errors (the entry is dropped and the prefix '
     'falls through to cold prefill), host = a failed async promotion '
-    'transfer (the request falls back to cold prefill).',
+    'transfer (the request falls back to cold prefill), peer = a '
+    'sibling replica fetch that timed out, errored, or returned a '
+    'corrupt payload (endpoint backs off, prefix prefills cold).',
     labelnames=('tier',),
 )
 for _tier in TIER_LABELS:
@@ -433,6 +436,9 @@ FLIGHT_KINDS = frozenset({
     'promote',  # host-tier blocks promoted back into the paged pool
                 # (blocks/tokens/put_s/wait_s/overlap; wait_s is the one
                 # audited completion sync of the async prefetch)
+    'peer_fetch',  # one .kvblock payload fetched from a sibling
+                   # replica's KVBlockServer over the fabric
+                   # (endpoint/blocks/bytes/fetch_s; docs/routing.md)
     'event',    # rare irregular events (scheduler exhaustion, ...)
     'compile',  # one startup/compile phase (observability/startup.py):
                 # backend init, warmup ladder shapes, layout migration
@@ -703,6 +709,61 @@ HTTP_RESPONSES = _registry.counter(
     'distllm_http_responses_total',
     'Responses completed by this server process (all paths).',
 )
+
+# ---------------------------------------------- multi-replica router
+# The prefix-affinity front-end (distllm_tpu/router/; docs/routing.md).
+# Runs in its own process, so these series appear on the ROUTER's
+# /metrics, not a replica's. Label tuples below are the single owners:
+# router/app.py and the pre-registration loops both iterate them.
+ROUTER_DECISION_LABELS = ('affinity', 'least_loaded', 'round_robin')
+ROUTER_REQUESTS = _registry.counter(
+    'distllm_router_requests_total',
+    'Requests proxied to a replica, by the routing decision that picked '
+    'it: affinity = the learned digest map matched the prompt prefix, '
+    'least_loaded = no affinity signal so the lightest /loadinfo queue '
+    'won, round_robin = the baseline rotation policy.',
+    labelnames=('decision',),
+)
+ROUTER_RETRIES = _registry.counter(
+    'distllm_router_retries_total',
+    'In-flight requests retried once on a healthy peer after their '
+    'first replica died mid-request (response carries '
+    'X-Distllm-Router-Retry: 1).',
+)
+ROUTER_FAILURES = _registry.counter(
+    'distllm_router_failures_total',
+    'Requests the router could not serve: no replica in rotation, or '
+    'the single retry also failed (client sees 502/503).',
+)
+ROUTER_UPSTREAM_REJECTIONS = _registry.counter(
+    'distllm_router_upstream_rejections_total',
+    'Replica 429 + Retry-After admission rejections propagated to the '
+    'client untouched — backpressure is the replica\'s call, never '
+    'retried elsewhere by the router.',
+)
+ROUTER_REPLICA_STATE_LABELS = ('healthy', 'draining', 'dead')
+ROUTER_REPLICAS = _registry.gauge(
+    'distllm_router_replicas',
+    'Replicas per rotation state: healthy = receiving new requests, '
+    'draining = finishing in-flight only (one-way; never rejoins), '
+    'dead = failed /health (rejoins when probes recover).',
+    labelnames=('state',),
+)
+ROUTER_AFFINITY_ENTRIES = _registry.gauge(
+    'distllm_router_affinity_entries',
+    'Digest entries currently held across all per-replica affinity LRU '
+    'maps (bounded by RouterConfig.affinity_map_size each).',
+)
+ROUTER_PROXY_SECONDS = _registry.histogram(
+    'distllm_router_proxy_seconds',
+    'End-to-end proxy latency per routed request (replica pick + '
+    'upstream round trip + relay), retries included.',
+    buckets=log_buckets(1e-3, 300.0),
+)
+for _decision in ROUTER_DECISION_LABELS:
+    ROUTER_REQUESTS.labels(decision=_decision)
+for _state in ROUTER_REPLICA_STATE_LABELS:
+    ROUTER_REPLICAS.labels(state=_state)
 
 # -------------------------------------------------------- fabric workers
 WORKER_HEARTBEATS = _registry.counter(

@@ -14,6 +14,11 @@ implements the same pattern directly:
   take many minutes) never get the worker falsely reaped.
 - :class:`ZmqPoolExecutor` — ``map(fn, items)`` facade over the coordinator
   matching the in-process executors' API.
+- :class:`KVBlockServer` / :class:`KVBlockClient` — digest-keyed KV block
+  exchange between serving replicas (docs/routing.md "Peer KV tier"): a
+  replica serves its own spilled ``.kvblock`` payloads, a sibling's
+  :class:`~distllm_tpu.generate.engine.kv_cache.PeerKVTier` fetches them —
+  the content-addressed KV-handoff seed of prefill/decode disaggregation.
 
 Worker functions must be module-level (pickle), exactly as with Parsl.
 """
@@ -342,6 +347,161 @@ class ZmqPoolExecutor:
                 raise value
             out.append(value)
         return out
+
+
+_KV_HAS = b'HAS'
+_KV_GET = b'GET'
+KV_HIT = b'KVHIT'
+KV_MISS = b'KVMISS'
+KV_ERR = b'KVERR'
+
+
+class KVBlockServer:
+    """ROUTER-socket server answering digest-keyed HAS/GET for one
+    replica's spilled KV blocks (docs/routing.md "Peer KV tier").
+
+    Transport only: ``has_fn(digest) -> bool`` and ``get_fn(digest) ->
+    bytes | None`` are injected (the engine wires them to its
+    ``HostKVTier.contains_local`` / ``encoded_local`` — metric-free,
+    peer-recursion-free), so the fabric never imports the KV layer. The
+    reply payload is the ``.kvblock`` v2 encoding — the same bytes the
+    disk tier persists, so peer handoff and restart-warm promotion share
+    one format. A handler exception answers ``KVERR`` instead of killing
+    the serve thread: one bad digest must not take the tier down.
+
+    Frame protocol (REQ client side adds/strips its empty delimiter):
+    request ``[cmd, digest]`` with cmd in ``{HAS, GET}``; reply
+    ``[status, payload]`` with status in ``{KVHIT, KVMISS, KVERR}``
+    (payload empty except for a GET hit).
+    """
+
+    def __init__(
+        self,
+        has_fn: Callable[[bytes], bool],
+        get_fn: Callable[[bytes], bytes | None],
+        bind: str = 'tcp://127.0.0.1:0',
+        advertise_host: str | None = None,
+    ) -> None:
+        import zmq
+
+        self._has_fn = has_fn
+        self._get_fn = get_fn
+        self._ctx = zmq.Context.instance()
+        # Touched only by the serve thread after start(); close() joins
+        # the thread before closing the socket.
+        self._socket = self._ctx.socket(zmq.ROUTER)
+        host = advertise_host or '127.0.0.1'
+        if bind.endswith(':0'):
+            port = self._socket.bind_to_random_port(bind[: bind.rfind(':')])
+            self.endpoint = f'tcp://{host}:{port}'
+        else:
+            self._socket.bind(bind)
+            self.endpoint = bind.replace('*', host)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name='kvblock-server', daemon=True
+        )
+        self.served_blocks = 0
+        self.served_bytes = 0
+
+    def start(self) -> 'KVBlockServer':
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        import zmq
+
+        poller = zmq.Poller()
+        poller.register(self._socket, zmq.POLLIN)
+        while not self._stop.is_set():
+            if self._socket not in dict(poller.poll(timeout=200)):
+                continue
+            frames = self._socket.recv_multipart()
+            ident, rest = frames[0], frames[1:]
+            # REQ clients carry an empty delimiter frame; DEALER probes
+            # may not — accept both.
+            if rest and rest[0] == b'':
+                rest = rest[1:]
+            status, payload = KV_ERR, b''
+            if len(rest) == 2:
+                cmd, digest = rest
+                try:
+                    if cmd == _KV_HAS:
+                        status = KV_HIT if self._has_fn(digest) else KV_MISS
+                    elif cmd == _KV_GET:
+                        encoded = self._get_fn(digest)
+                        if encoded is None:
+                            status = KV_MISS
+                        else:
+                            status, payload = KV_HIT, encoded
+                            self.served_blocks += 1
+                            self.served_bytes += len(encoded)
+                # distlint: disable=swallowed-exception -- surfaced on the wire as KVERR; the FETCHING side counts the degradation (distllm_prefix_tier_errors_total{tier="peer"}) and falls through to cold prefill
+                except Exception:
+                    status = KV_ERR
+            self._socket.send_multipart([ident, b'', status, payload])
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+        self._socket.close(linger=0)
+
+
+class KVBlockClient:
+    """Bounded-timeout REQ client for :class:`KVBlockServer` endpoints.
+
+    One REQ socket per endpoint, recreated after any timeout or transport
+    error (the lazy-pirate pattern: a REQ that missed its reply is wedged
+    in send state and must be discarded). ``request`` returns ``(status,
+    payload)`` or None on transport failure — the caller
+    (:class:`~distllm_tpu.generate.engine.kv_cache.PeerKVTier`) owns the
+    backoff and metric accounting. Thread-safe: the engine loop and the
+    server's admission thread may race fetches.
+    """
+
+    def __init__(self, timeout_ms: int = 500) -> None:
+        import zmq
+
+        self._ctx = zmq.Context.instance()
+        self.timeout_ms = int(timeout_ms)
+        self._lock = threading.Lock()
+        self._sockets: dict[str, Any] = {}  # guarded by self._lock
+
+    def request(
+        self, endpoint: str, cmd: bytes, digest: bytes
+    ) -> tuple[bytes, bytes] | None:
+        import zmq
+
+        with self._lock:
+            sock = self._sockets.get(endpoint)
+            if sock is None:
+                sock = self._ctx.socket(zmq.REQ)
+                sock.setsockopt(zmq.LINGER, 0)
+                sock.connect(endpoint)
+                self._sockets[endpoint] = sock
+            try:
+                sock.send_multipart([cmd, digest])
+                if sock.poll(self.timeout_ms, zmq.POLLIN):
+                    frames = sock.recv_multipart()
+                    return (
+                        frames[0],
+                        frames[1] if len(frames) > 1 else b'',
+                    )
+            # distlint: disable=swallowed-exception -- degradation is the contract: None routes through PeerKVTier._note_failure, which counts distllm_prefix_tier_errors_total{tier="peer"} and backs the endpoint off
+            except zmq.ZMQError:
+                pass
+            # Timeout or error: the REQ state machine is wedged — drop
+            # the socket so the next request starts clean.
+            sock.close(linger=0)
+            del self._sockets[endpoint]
+            return None
+
+    def close(self) -> None:
+        with self._lock:
+            for sock in self._sockets.values():
+                sock.close(linger=0)
+            self._sockets.clear()
 
 
 def map_with_teardown(executor, fn: Callable, items: Iterable[Any]) -> list[Any]:

@@ -1,0 +1,25 @@
+"""Multi-replica serving tier (docs/routing.md).
+
+A prefix-cache-aware HTTP router fronting N ``chat_server`` replicas
+(``router/app.py``; entry point ``scripts/router.py``), plus the shared
+affinity bookkeeping (``router/affinity.py``) the replicas use to
+annotate responses. The peer KV tier that lets replicas hand spilled
+blocks to each other lives with the rest of the tier cascade in
+``generate/engine/kv_cache.py`` (:class:`PeerKVTier`) and the fabric
+transport in ``parallel/fabric.py`` (:class:`KVBlockServer` /
+:class:`KVBlockClient`).
+"""
+
+from distllm_tpu.router.affinity import (
+    AffinityMap,
+    prompt_prefix_digests,
+)
+from distllm_tpu.router.app import Replica, RouterConfig, build_router_app
+
+__all__ = [
+    'AffinityMap',
+    'Replica',
+    'RouterConfig',
+    'build_router_app',
+    'prompt_prefix_digests',
+]

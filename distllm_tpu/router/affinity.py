@@ -1,0 +1,128 @@
+"""Prefix-affinity bookkeeping for the multi-replica router.
+
+The routing signal is the same content address the replicas key their KV
+tiers by: a chained digest sequence (``kv_cache.block_digests``) over the
+request's prompt prefix. The router has no tokenizer, so the chain runs
+over the canonical UTF-8 *bytes* of the OpenAI message list instead of
+token ids — both sides (router pick, replica response header) compute it
+with :func:`prompt_prefix_digests`, so the addresses agree without the
+router ever loading a model. A byte-level chain is coarser than the
+replica's token-level tier chain, but it has the one property affinity
+needs: two requests sharing a message-prefix share a digest-chain prefix,
+and a request extending a session extends its chain (append-only render).
+
+Learning protocol (docs/routing.md "Digest learning"): every completion
+response carries ``X-Distllm-Prefix-Digest`` (hex of the deepest chain
+digest the replica now holds) and ``X-Distllm-Prefix-Depth`` (its chain
+index + 1). The router verifies the advertised digest against its own
+chain for that request — a mismatch (different block_bytes, a proxy that
+rewrote the body) drops the sample instead of poisoning the map — then
+inserts ``chain[:depth]`` into that replica's bounded LRU
+:class:`AffinityMap`. Routing scores each replica by the longest chain
+prefix present in its map; depth 0 everywhere falls back to least-loaded.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Iterable, Mapping, Sequence
+
+from distllm_tpu.generate.engine.kv_cache import block_digests
+
+# Digest-chain block granularity in BYTES of rendered prompt prefix.
+# Small enough that a one-turn system prompt already spans several
+# blocks, large enough that the per-request chain stays short. Router
+# and replica must agree — both default to this constant.
+DEFAULT_BLOCK_BYTES = 64
+
+HEADER_DIGEST = 'X-Distllm-Prefix-Digest'
+HEADER_DEPTH = 'X-Distllm-Prefix-Depth'
+HEADER_RETRY = 'X-Distllm-Router-Retry'
+HEADER_REPLICA = 'X-Distllm-Router-Replica'
+
+
+def prompt_prefix_bytes(messages: Iterable[Mapping]) -> bytes:
+    """Canonical append-only byte rendering of an OpenAI message list.
+
+    Unit-separator framing (0x1f between role and content, 0x1e after
+    each message) keeps the encoding injective — ``[{'a'},{'b'}]`` and
+    ``[{'ab'}]`` must not collide — and appending a message appends
+    bytes, so a growing conversation grows its digest chain in place.
+    """
+    parts = []
+    for message in messages:
+        role = str(message.get('role', ''))
+        content = str(message.get('content', ''))
+        parts.append(f'{role}\x1f{content}\x1e')
+    return ''.join(parts).encode('utf-8', 'replace')
+
+
+def prompt_prefix_digests(
+    messages: Iterable[Mapping], block_bytes: int = DEFAULT_BLOCK_BYTES
+) -> list[bytes]:
+    """Chained digests over full ``block_bytes`` blocks of the rendered
+    prompt (bytes are a ``Sequence[int]``, so the replicas' own
+    ``block_digests`` chain does the hashing). Prompts shorter than one
+    block get an empty chain — no affinity signal, by design."""
+    return block_digests(prompt_prefix_bytes(messages), block_bytes)
+
+
+class AffinityMap:
+    """Bounded per-replica digest LRU maps learned from response headers.
+
+    Not thread-safe: the router is a single asyncio loop and all
+    learn/score/drop calls run on it.
+    """
+
+    def __init__(self, max_entries_per_replica: int = 4096) -> None:
+        self.max_entries = int(max_entries_per_replica)
+        self._maps: dict[str, OrderedDict[bytes, None]] = {}
+
+    def learn(self, replica: str, chain: Sequence[bytes]) -> None:
+        lru = self._maps.setdefault(replica, OrderedDict())
+        for digest in chain:
+            lru[digest] = None
+            lru.move_to_end(digest)
+        while len(lru) > self.max_entries:
+            lru.popitem(last=False)
+
+    def verify_and_learn(
+        self, replica: str, chain: Sequence[bytes],
+        digest_hex: str | None, depth_text: str | None,
+    ) -> int:
+        """Apply one response-header learning sample; returns the depth
+        learned (0 = sample dropped). The advertised digest must equal
+        our own ``chain[depth-1]`` — agreement proves both sides hashed
+        the same bytes at the same granularity."""
+        if not digest_hex or not depth_text:
+            return 0
+        try:
+            depth = int(depth_text)
+            advertised = bytes.fromhex(digest_hex)
+        # distlint: disable=swallowed-exception -- a malformed learning header is an untrusted-input sample to drop, not an error: routing falls back to least-loaded and the next well-formed response re-teaches the map
+        except ValueError:
+            return 0
+        if depth < 1 or depth > len(chain) or chain[depth - 1] != advertised:
+            return 0
+        self.learn(replica, chain[:depth])
+        return depth
+
+    def score(self, replica: str, chain: Sequence[bytes]) -> int:
+        """Longest chain prefix present in ``replica``'s map (the
+        expected warm depth if routed there)."""
+        lru = self._maps.get(replica)
+        if not lru:
+            return 0
+        depth = 0
+        for digest in chain:
+            if digest not in lru:
+                break
+            depth += 1
+        return depth
+
+    def drop(self, replica: str) -> None:
+        """Forget a replica (left rotation for good — its cache is gone)."""
+        self._maps.pop(replica, None)
+
+    def entries(self) -> int:
+        return sum(len(lru) for lru in self._maps.values())

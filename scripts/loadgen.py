@@ -17,6 +17,13 @@ Examples::
     # chip-scale open-loop run, 7B dims, 512 requests at 16 rps
     python scripts/loadgen.py --requests 512 --rate 16 --slo 2.0
 
+    # HTTP client mode: same Poisson workload against a running
+    # chat_server or the multi-replica router (docs/routing.md) — no
+    # in-process engine, TTFT measured from the SCHEDULED arrival
+    # (coordinated-omission corrected)
+    python scripts/loadgen.py --endpoint http://127.0.0.1:8000 \
+        --requests 64 --rate 8
+
 The bench's checkpointed ``gen_load`` stage wraps the same machinery; this
 CLI exists for interactive what-if runs against one engine config.
 """
@@ -72,7 +79,47 @@ def main(argv: list[str] | None = None) -> int:
         help='metric-history sampler tick, seconds; the report carries a '
              'compact excerpt (tok/s series + burn-rate gauges) from the '
              'retained history (docs/observability.md)')
+    parser.add_argument(
+        '--endpoint', type=str, default=None,
+        help='drive an OpenAI-compatible HTTP endpoint (chat_server or '
+             'the router, docs/routing.md) instead of building an '
+             'in-process engine; engine flags are ignored in this mode')
+    parser.add_argument(
+        '--timeout', type=float, default=120.0,
+        help='per-request HTTP timeout seconds (endpoint mode only)')
     args = parser.parse_args(argv)
+
+    if args.endpoint:
+        # HTTP client mode: no engine, no jax — the workload builder and
+        # the asyncio driver are all this path needs.
+        from distllm_tpu.generate.loadgen import (
+            LoadgenConfig,
+            build_workload,
+            run_http_loadgen,
+        )
+
+        load_cfg = LoadgenConfig(
+            seed=args.seed,
+            num_requests=args.requests,
+            rate_rps=args.rate,
+            num_sessions=args.sessions,
+            warm_fraction=args.warm_fraction,
+            prefix_tokens=args.prefix_tokens,
+            temperature=args.temperature,
+            top_p=args.top_p,
+        )
+        report = run_http_loadgen(
+            args.endpoint,
+            build_workload(load_cfg),
+            slo_s=args.slo,
+            timeout_s=args.timeout,
+        )
+        fragment = report.to_fragment('loadgen_http_')
+        fragment['loadgen_http_endpoint'] = args.endpoint
+        if report.by_replica:
+            fragment['loadgen_http_by_replica'] = report.by_replica
+        print(json.dumps(fragment))
+        return 0
 
     import jax
 

@@ -1,0 +1,69 @@
+"""Multi-replica router CLI (docs/routing.md).
+
+Fronts N chat_server replicas with the prefix-affinity router
+(``distllm_tpu/router/``): OpenAI-compatible ``POST /v1/chat/completions``
+in, cache-aware replica pick + proxy out, ``GET /health`` and
+``GET /metrics`` (``distllm_router_*`` series) on the side.
+
+Examples::
+
+    # two replicas, prefix-affinity routing (the default policy)
+    python scripts/router.py --replica http://127.0.0.1:8001 \
+        --replica http://127.0.0.1:8002 --port 8000
+
+    # round-robin baseline for an A/B
+    python scripts/router.py --replica http://127.0.0.1:8001 \
+        --replica http://127.0.0.1:8002 --policy round_robin
+
+    # everything from a YAML RouterConfig
+    python scripts/router.py --config router.yaml
+
+The router process is stateless across restarts: affinity maps re-learn
+from the ``X-Distllm-Prefix-Digest`` response headers within a few
+requests per session.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--config', type=str, default=None,
+                        help='YAML RouterConfig (replicas, policy, knobs)')
+    parser.add_argument('--replica', action='append', default=None,
+                        metavar='URL',
+                        help='replica base URL (repeatable); overrides the '
+                             'config file list when given')
+    parser.add_argument('--policy', type=str, default=None,
+                        choices=('prefix_affinity', 'least_loaded',
+                                 'round_robin'))
+    parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--port', type=int, default=8000)
+    args = parser.parse_args(argv)
+
+    from aiohttp import web
+
+    from distllm_tpu.router import RouterConfig, build_router_app
+
+    config = (
+        RouterConfig.from_yaml(args.config) if args.config else RouterConfig()
+    )
+    if args.replica:
+        config = config.model_copy(update={'replicas': tuple(args.replica)})
+    if args.policy:
+        config = config.model_copy(update={'policy': args.policy})
+    if not config.replicas:
+        parser.error('at least one --replica (or a config with replicas) '
+                     'is required')
+    web.run_app(build_router_app(config), host=args.host, port=args.port)
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -148,6 +148,19 @@ def test_library_surface_matches_cli():
         benchdiff.gate_direction('gen_tier_promotion_overlap') == 'higher'
     )
     assert benchdiff.gate_direction('gen_tier_hit_rate') == 'higher'
+    # gen_router (multi-replica tier) headline gates: the affinity-vs-RR
+    # warm-TTFT speedup ratio and the replica-kill goodput both gate
+    # higher-better (docs/routing.md).
+    assert (
+        benchdiff.gate_direction('gen_router_router_warm_ttft_speedup')
+        == 'higher'
+    )
+    assert (
+        benchdiff.gate_direction('gen_router_failover_goodput') == 'higher'
+    )
+    assert (
+        benchdiff.gate_direction('gen_router_affinity_ttft_p95') == 'lower'
+    )
     assert benchdiff.gate_direction('gen_tier_spills') is None
     assert benchdiff.gate_direction('gen_tier_promotions') is None
     assert benchdiff.gate_direction('gen_tier_spilled_blocks') is None

@@ -1,0 +1,464 @@
+"""Multi-replica router tests (docs/routing.md).
+
+Covers the routing contract end-to-end over real sockets: rotation and
+replica attribution, digest-affinity learning from response headers,
+least-loaded fallback, ONE-WAY drain, retry-once on a dead replica, 429
+passthrough — plus the replica-side surface (digest headers + /loadinfo
+on chat_server) and the replica-aware Perfetto merge naming.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+aiohttp = pytest.importorskip('aiohttp')
+import requests  # noqa: E402
+from aiohttp import web  # noqa: E402
+
+from distllm_tpu.router import (  # noqa: E402
+    AffinityMap,
+    RouterConfig,
+    build_router_app,
+    prompt_prefix_digests,
+)
+from distllm_tpu.router.affinity import (  # noqa: E402
+    HEADER_DEPTH,
+    HEADER_DIGEST,
+    HEADER_REPLICA,
+    HEADER_RETRY,
+    prompt_prefix_bytes,
+)
+
+# ----------------------------------------------------------- test servers
+
+
+def _serve(app):
+    """Boot an aiohttp app on a free port in a daemon thread; returns
+    ``(base_url, stop)``. Same shape as tests/test_chat.py's helper but
+    app-generic — the router tests boot stubs AND routers with it."""
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    holder: dict = {}
+
+    def run():
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        holder['loop'] = loop
+        runner = web.AppRunner(app, shutdown_timeout=1.0)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        holder['runner'] = runner
+        loop.run_forever()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    for _ in range(100):
+        try:
+            requests.get(f'http://127.0.0.1:{port}/health', timeout=1)
+            break
+        except Exception:
+            time.sleep(0.05)
+    done = {'stopped': False}
+
+    def stop():
+        if done['stopped']:
+            return
+        done['stopped'] = True
+        loop = holder['loop']
+
+        async def _shutdown():
+            await holder['runner'].cleanup()
+            loop.stop()
+
+        loop.call_soon_threadsafe(lambda: loop.create_task(_shutdown()))
+        thread.join(timeout=10)
+
+    return f'http://127.0.0.1:{port}', stop
+
+
+def _stub_replica(*, reply_status=200, retry_after='7'):
+    """Minimal chat_server-shaped stub: /health, /loadinfo, and a
+    completions handler that annotates digest headers exactly like the
+    real replica. Returns ``(app, state, calls)`` — flip ``state`` keys
+    to drive health transitions; ``calls`` records request bodies."""
+    state = {
+        'ready': True,
+        'draining': False,
+        'loadinfo': {'queue_depth': 0, 'in_flight': 0, 'kv_occupancy': 0.0},
+    }
+    calls: list[dict] = []
+
+    async def health(request):
+        return web.json_response(
+            {'ready': state['ready'], 'draining': state['draining']}
+        )
+
+    async def loadinfo(request):
+        return web.json_response(state['loadinfo'])
+
+    async def completions(request):
+        body = await request.json()
+        calls.append(body)
+        if reply_status == 429:
+            return web.json_response(
+                {'error': {'message': 'queue full', 'type': 'overloaded'}},
+                status=429,
+                headers={'Retry-After': retry_after},
+            )
+        headers = {}
+        chain = prompt_prefix_digests(body.get('messages', []))
+        if chain:
+            headers[HEADER_DIGEST] = chain[-1].hex()
+            headers[HEADER_DEPTH] = str(len(chain))
+        return web.json_response(
+            {'choices': [{'message': {'content': 'ok',
+                                      'role': 'assistant'}}]},
+            headers=headers,
+        )
+
+    app = web.Application()
+    app.router.add_get('/health', health)
+    app.router.add_get('/loadinfo', loadinfo)
+    app.router.add_post('/v1/chat/completions', completions)
+    return app, state, calls
+
+
+def _router(urls, policy, **overrides):
+    config = RouterConfig(
+        replicas=tuple(urls),
+        policy=policy,
+        loadinfo_ttl_s=overrides.pop('loadinfo_ttl_s', 0.01),
+        health_interval_s=overrides.pop('health_interval_s', 30.0),
+        request_timeout_s=10.0,
+        **overrides,
+    )
+    return _serve(build_router_app(config))
+
+
+def _messages(text: str) -> list[dict]:
+    return [{'role': 'user', 'content': text}]
+
+
+def _post(url, messages, **body):
+    return requests.post(
+        f'{url}/v1/chat/completions',
+        json={'messages': messages, **body},
+        timeout=10,
+    )
+
+
+# ------------------------------------------------------- digest affinity
+
+
+def test_prompt_prefix_digests_shared_prefix_shared_chain():
+    # Rendered bytes: 'user\x1f' + content + '\x1e'. A 150-char shared
+    # prefix fills 2 full 64-byte blocks; the 100-char distinct tails
+    # land inside later FULL blocks (the chain emits full blocks only,
+    # so a divergence past the last full block would be invisible).
+    shared = 'x' * 150
+    chain_a = prompt_prefix_digests(_messages(shared + 'a' * 100))
+    chain_b = prompt_prefix_digests(_messages(shared + 'b' * 100))
+    assert chain_a and chain_b
+    shared_blocks = (5 + len(shared)) // 64
+    assert shared_blocks == 2
+    assert chain_a[:shared_blocks] == chain_b[:shared_blocks]
+    assert chain_a != chain_b
+    # Byte rendering is injective on (role, content) boundaries.
+    assert prompt_prefix_bytes(_messages('ab')) != prompt_prefix_bytes(
+        [{'role': 'usera', 'content': 'b'}]
+    )
+
+
+def test_affinity_map_verify_and_learn():
+    chain = prompt_prefix_digests(_messages('y' * 300))
+    assert len(chain) >= 2
+    amap = AffinityMap()
+    # Untrusted header must MATCH the locally computed chain to be
+    # learned: wrong hex, malformed hex, and out-of-range depths all
+    # teach nothing.
+    assert amap.verify_and_learn('r1', chain, 'ff' * 32, str(len(chain))) == 0
+    assert amap.verify_and_learn('r1', chain, 'zz', '1') == 0
+    assert amap.verify_and_learn('r1', chain, chain[-1].hex(), '0') == 0
+    assert (
+        amap.verify_and_learn('r1', chain, chain[-1].hex(),
+                              str(len(chain) + 1))
+        == 0
+    )
+    assert amap.score('r1', chain) == 0
+    depth = amap.verify_and_learn(
+        'r1', chain, chain[-1].hex(), str(len(chain))
+    )
+    assert depth == len(chain)
+    assert amap.score('r1', chain) == len(chain)
+    assert amap.score('r2', chain) == 0
+    amap.drop('r1')
+    assert amap.score('r1', chain) == 0
+
+
+def test_affinity_map_lru_bound():
+    amap = AffinityMap(max_entries_per_replica=4)
+    chains = [
+        prompt_prefix_digests(_messages(f'session-{i} ' + 'z' * 100))
+        for i in range(6)
+    ]
+    for chain in chains:
+        amap.learn('r1', chain)
+    assert amap.entries() <= 4
+    # The oldest chains fell off; the newest survive.
+    assert amap.score('r1', chains[-1]) >= 1
+    assert amap.score('r1', chains[0]) == 0
+
+
+# --------------------------------------------------------- routing policy
+
+
+def test_round_robin_rotation_and_replica_header():
+    app_a, _, calls_a = _stub_replica()
+    app_b, _, calls_b = _stub_replica()
+    url_a, stop_a = _serve(app_a)
+    url_b, stop_b = _serve(app_b)
+    router_url, stop_r = _router([url_a, url_b], 'round_robin')
+    try:
+        replicas_seen = []
+        for i in range(4):
+            resp = _post(router_url, _messages(f'req {i}'))
+            assert resp.status_code == 200
+            replicas_seen.append(resp.headers[HEADER_REPLICA])
+        assert len(calls_a) == 2 and len(calls_b) == 2
+        assert len(set(replicas_seen)) == 2
+    finally:
+        stop_r(), stop_a(), stop_b()
+
+
+def test_prefix_affinity_pins_sessions_after_learning():
+    app_a, _, calls_a = _stub_replica()
+    app_b, _, calls_b = _stub_replica()
+    url_a, stop_a = _serve(app_a)
+    url_b, stop_b = _serve(app_b)
+    router_url, stop_r = _router([url_a, url_b], 'prefix_affinity')
+    try:
+        session_text = 'session-alpha ' + 'p' * 150
+        first = _post(router_url, _messages(session_text + ' turn 0'))
+        assert first.status_code == 200
+        home = first.headers[HEADER_REPLICA]
+        # The digest headers from the first response taught the router
+        # this session's residency: every repeat goes home.
+        for turn in range(1, 4):
+            resp = _post(
+                router_url, _messages(session_text + f' turn {turn}')
+            )
+            assert resp.status_code == 200
+            assert resp.headers[HEADER_REPLICA] == home
+    finally:
+        stop_r(), stop_a(), stop_b()
+
+
+def test_least_loaded_fallback_prefers_light_queue():
+    app_a, state_a, calls_a = _stub_replica()
+    app_b, _, calls_b = _stub_replica()
+    state_a['loadinfo'] = {
+        'queue_depth': 5, 'in_flight': 3, 'kv_occupancy': 0.9
+    }
+    url_a, stop_a = _serve(app_a)
+    url_b, stop_b = _serve(app_b)
+    router_url, stop_r = _router([url_a, url_b], 'least_loaded')
+    try:
+        for i in range(3):
+            resp = _post(router_url, _messages(f'cold {i}'))
+            assert resp.status_code == 200
+        assert len(calls_b) == 3 and len(calls_a) == 0
+    finally:
+        stop_r(), stop_a(), stop_b()
+
+
+def test_drain_is_one_way_and_gets_no_new_requests():
+    app_a, state_a, calls_a = _stub_replica()
+    app_b, _, calls_b = _stub_replica()
+    url_a, stop_a = _serve(app_a)
+    url_b, stop_b = _serve(app_b)
+    router_url, stop_r = _router(
+        [url_a, url_b], 'round_robin', health_interval_s=0.05
+    )
+    try:
+        state_a['draining'] = True
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            doc = requests.get(f'{router_url}/health', timeout=5).json()
+            if 'draining' in doc['replicas'].values():
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail('router never observed the drain')
+        before = len(calls_a)
+        for i in range(4):
+            assert _post(router_url, _messages(f'r {i}')).status_code == 200
+        assert len(calls_a) == before  # zero NEW requests while draining
+        assert len(calls_b) >= 4
+        # One-way: the replica answering ready again must NOT rejoin —
+        # its process restart means its cache (and its drained state's
+        # reason) are gone; rotation re-entry is an operator action.
+        state_a['draining'] = False
+        state_a['ready'] = True
+        time.sleep(0.3)
+        before = len(calls_a)
+        for i in range(3):
+            assert _post(router_url, _messages(f's {i}')).status_code == 200
+        assert len(calls_a) == before
+        doc = requests.get(f'{router_url}/health', timeout=5).json()
+        assert 'draining' in doc['replicas'].values()
+    finally:
+        stop_r(), stop_a(), stop_b()
+
+
+def test_dead_replica_retry_once_with_marker():
+    app_a, _, _ = _stub_replica()
+    app_b, _, calls_b = _stub_replica()
+    url_a, stop_a = _serve(app_a)
+    url_b, stop_b = _serve(app_b)
+    # Probes effectively off: the router must DISCOVER the death on the
+    # proxy path. round_robin's first pick is replicas[0] — the corpse.
+    router_url, stop_r = _router([url_a, url_b], 'round_robin')
+    try:
+        stop_a()
+        resp = _post(router_url, _messages('failover me'))
+        assert resp.status_code == 200
+        assert resp.headers[HEADER_RETRY] == '1'
+        assert resp.headers[HEADER_REPLICA] == url_b.split('//', 1)[1]
+        assert len(calls_b) == 1
+        # The dead replica left rotation: no more retry markers.
+        resp = _post(router_url, _messages('again'))
+        assert resp.status_code == 200
+        assert HEADER_RETRY not in resp.headers
+    finally:
+        stop_r(), stop_a(), stop_b()
+
+
+def test_429_propagates_untouched_and_is_not_retried():
+    app_a, _, calls_a = _stub_replica(reply_status=429, retry_after='9')
+    app_b, _, calls_b = _stub_replica()
+    url_a, stop_a = _serve(app_a)
+    url_b, stop_b = _serve(app_b)
+    router_url, stop_r = _router([url_a, url_b], 'round_robin')
+    try:
+        statuses = [
+            _post(router_url, _messages(f'r {i}')) for i in range(2)
+        ]
+        rejected = [r for r in statuses if r.status_code == 429]
+        assert len(rejected) == 1  # round robin: exactly one hit the
+        # admission-controlled replica, and its refusal was NOT moved
+        # elsewhere (retrying defeats admission control)
+        assert rejected[0].headers['Retry-After'] == '9'
+        assert rejected[0].json()['error']['type'] == 'overloaded'
+        assert HEADER_RETRY not in rejected[0].headers
+        assert len(calls_a) == 1 and len(calls_b) == 1
+    finally:
+        stop_r(), stop_a(), stop_b()
+
+
+def test_router_health_reports_states():
+    app_a, _, _ = _stub_replica()
+    url_a, stop_a = _serve(app_a)
+    router_url, stop_r = _router([url_a], 'prefix_affinity')
+    try:
+        doc = requests.get(f'{router_url}/health', timeout=5).json()
+        assert doc['ready'] is True
+        assert doc['policy'] == 'prefix_affinity'
+        assert list(doc['replicas'].values()) == ['healthy']
+    finally:
+        stop_r(), stop_a()
+
+
+# ------------------------------------------------------ replica surface
+
+
+def test_chat_server_digest_headers_and_loadinfo():
+    from distllm_tpu.chat import ChatAppConfig
+    from distllm_tpu.chat_server import build_app
+    from distllm_tpu.registry import registry
+
+    url, stop = _serve(build_app(ChatAppConfig()))
+    try:
+        messages = _messages('q' * 200)
+        resp = requests.post(
+            f'{url}/v1/chat/completions',
+            json={'messages': messages},
+            timeout=10,
+        )
+        assert resp.status_code == 200
+        chain = prompt_prefix_digests(messages)
+        assert resp.headers[HEADER_DIGEST] == chain[-1].hex()
+        assert int(resp.headers[HEADER_DEPTH]) == len(chain)
+
+        info = requests.get(f'{url}/loadinfo', timeout=5).json()
+        assert info['ready'] is True and info['draining'] is False
+        # The fake generator has no engine: load fields degrade to the
+        # idle shape rather than erroring.
+        assert info['queue_depth'] == 0
+        assert 0.0 <= info['kv_occupancy'] <= 1.0
+        assert isinstance(info['in_flight'], int)
+    finally:
+        stop()
+        registry().clear()
+
+
+# -------------------------------------------------- replica-aware merge
+
+
+def test_host_label_parses_replica_ids(tmp_path):
+    from distllm_tpu.observability.aggregate import host_label
+
+    # Generic stems take the parent (the replica/host id)…
+    assert host_label('bundle/replica-0/flight.jsonl') == 'replica-0'
+    assert host_label('bundle/replica-1/spans.jsonl') == 'replica-1'
+    # …distinctive stems keep themselves.
+    assert host_label('logs/capture-host3.jsonl') == 'capture-host3'
+    # Collisions stay distinguishable: the stem is appended first, then
+    # an index once THAT collides too.
+    seen: set = set()
+    assert host_label('a/replica-0/flight.jsonl', seen) == 'replica-0'
+    assert (
+        host_label('b/replica-0/flight.jsonl', seen) == 'replica-0/flight'
+    )
+    assert (
+        host_label('c/replica-0/flight.jsonl', seen)
+        == 'replica-0/flight#2'
+    )
+
+
+def test_combined_perfetto_merge_names_replicas(tmp_path):
+    from distllm_tpu.observability.aggregate import write_combined_perfetto
+
+    paths = []
+    for r in range(2):
+        d = tmp_path / f'replica-{r}'
+        d.mkdir()
+        path = d / 'flight.jsonl'
+        records = [
+            {'kind': 'prefill', 't_wall': 100.0 + r, 'duration_s': 0.05,
+             'batch': 1, 'tokens': 32},
+            {'kind': 'decode', 't_wall': 100.2 + r, 'duration_s': 0.02,
+             'batch': 1, 'tokens': 4},
+        ]
+        path.write_text(
+            '\n'.join(json.dumps(rec) for rec in records) + '\n'
+        )
+        paths.append(path)
+    out = tmp_path / 'combined.json'
+    assert write_combined_perfetto(paths, out) == 2
+    doc = json.loads(out.read_text())
+    process_names = {
+        e['args']['name'] for e in doc['traceEvents']
+        if e['ph'] == 'M' and e['name'] == 'process_name'
+    }
+    # The fix under test: N identical 'flight.jsonl' basenames would
+    # have collapsed into one unreadable process group.
+    assert process_names == {'replica-0', 'replica-1'}
